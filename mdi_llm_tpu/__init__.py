@@ -1,0 +1,14 @@
+"""mdi_llm_tpu — TPU-native model-distributed LLM inference & training.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+davmacario/MDI-LLM (recurrent pipeline-parallel LLM inference across devices,
+single-device generation/chat, training, checkpoint tooling) for TPU
+hardware: pjit/shard_map over device meshes, ppermute activation hops over
+ICI/DCN, layer-stacked scanned transformer blocks, functional KV caches.
+"""
+
+__version__ = "0.1.0"
+
+from mdi_llm_tpu.config import Config, name_to_config
+
+__all__ = ["Config", "name_to_config", "__version__"]

@@ -1,0 +1,966 @@
+"""Model configuration and the named-model registry.
+
+TPU-native re-design of the reference's model configuration layer
+(`/root/reference/src/sub/config.py:175-1669` and the `Config` dataclass at
+`/root/reference/src/sub/model.py:93-273`).  Field names follow the public
+litGPT schema so that `model_config.yaml` files written by the reference (and
+by litGPT itself) load unchanged, and so HF checkpoint conversion can share
+weight layouts.  The implementation is new: plain dataclass + dict registry,
+no torch dependency, plus TPU-specific additions (`pos_embedding` for the
+legacy GPT-2 generation, dtype policy helpers).
+
+Registry notes: entries are generated programmatically per model family from
+public architecture specs.  `Config.from_hf_config` exists as the ground-truth
+path — an HF `config.json` always wins over the registry.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Config",
+    "name_to_config",
+    "configs",
+    "find_multiple",
+    # generation defaults (parity with reference src/sub/config.py:47-52)
+    "TOP_K",
+    "TEMPERATURE",
+]
+
+# Generation defaults — parity with reference `src/sub/config.py:47-52`.
+TOP_K = 200
+TEMPERATURE = 0.8
+
+# Default RNG seed used across all reference entry points
+# (`starter.py:195`, `sample.py:354`, `train.py:471`).
+DEFAULT_SEED = 10137
+
+
+def find_multiple(n: int, k: int) -> int:
+    """Smallest multiple of `k` that is >= `n`."""
+    if n % k == 0:
+        return n
+    return n + k - (n % k)
+
+
+@dataclass
+class Config:
+    """Architecture hyper-parameters for one decoder-only transformer.
+
+    Field names intentionally match the public litGPT schema (the reference's
+    `Config` at `model.py:93-183` is litGPT-derived) so YAML checkpoints
+    interoperate.  Extra TPU-framework fields are listed at the bottom.
+    """
+
+    name: str = ""
+    hf_config: dict = field(default_factory=dict)
+    scale_embeddings: bool = False
+    block_size: int = 4096
+    vocab_size: int = 50254
+    padding_multiple: int = 512
+    padded_vocab_size: Optional[int] = None
+    n_layer: int = 16
+    n_head: int = 32
+    head_size: Optional[int] = None
+    n_embd: int = 4096
+    rotary_percentage: float = 0.25
+    parallel_residual: bool = True
+    bias: bool = True
+    lm_head_bias: bool = False
+    # n_query_groups: n_head => MHA, 1 => MQA, in between => GQA
+    n_query_groups: Optional[int] = None
+    shared_attention_norm: bool = False
+    norm_class_name: str = "LayerNorm"  # "LayerNorm" | "RMSNorm"
+    norm_eps: float = 1e-5
+    mlp_class_name: str = "GptNeoxMLP"  # GptNeoxMLP | LLaMAMLP | GemmaMLP | LLaMAMoE
+    gelu_approximate: str = "none"
+    intermediate_size: Optional[int] = None
+    rope_condense_ratio: int = 1
+    rope_base: int = 10000
+    n_expert: int = 0
+    n_expert_per_token: int = 0
+
+    # ---- TPU-framework extensions (not in litGPT) --------------------------
+    # "rope" for all modern families; "learned" resurrects the legacy GPT-2
+    # generation (reference `old/GPT2/sub/model.py`) with learned absolute
+    # position embeddings.
+    pos_embedding: str = "rope"
+    # Tie lm_head to wte (Gemma, GPT-2, and scratch-trained models).
+    tie_embeddings: bool = False
+    # Gemma-style RMSNorm: weight enters as (1 + w) (reference RMSNorm
+    # unit-offset variant, model.py:950-981).
+    rmsnorm_add_unit_offset: bool = False
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = self.hf_config.get("name", self.name)
+
+        if self.head_size is None:
+            assert self.n_embd % self.n_head == 0, (self.n_embd, self.n_head)
+            self.head_size = self.n_embd // self.n_head
+
+        if self.padded_vocab_size is None:
+            self.padded_vocab_size = find_multiple(
+                self.vocab_size, self.padding_multiple
+            )
+        else:
+            self.vocab_size = min(self.vocab_size, self.padded_vocab_size)
+
+        if self.n_query_groups is not None:
+            assert self.n_head % self.n_query_groups == 0
+        else:
+            self.n_query_groups = self.n_head
+
+        if self.intermediate_size is None:
+            if self.mlp_class_name == "LLaMAMLP":
+                raise ValueError(
+                    f"config {self.name!r} needs `intermediate_size` for LLaMAMLP"
+                )
+            self.intermediate_size = 4 * self.n_embd
+
+        self.rope_n_elem = int(self.rotary_percentage * self.head_size)
+
+    # ---- derived sizes -----------------------------------------------------
+
+    @property
+    def qkv_size(self) -> int:
+        """Rows of the fused QKV projection (litGPT layout: interleaved
+        per-group [q*q_per_kv, k, v])."""
+        q_per_kv = self.n_head // self.n_query_groups
+        return (q_per_kv + 2) * self.head_size * self.n_query_groups
+
+    @property
+    def attn_out_size(self) -> int:
+        return self.head_size * self.n_head
+
+    def estimate_params(self) -> int:
+        """Rough parameter count (embeddings counted once if tied)."""
+        V, D, L = self.padded_vocab_size, self.n_embd, self.n_layer
+        emb = V * D
+        head = 0 if self.tie_embeddings else V * D
+        attn = D * self.qkv_size + self.attn_out_size * D
+        if self.bias:
+            attn += self.qkv_size + D
+        I = self.intermediate_size
+        if self.mlp_class_name in ("LLaMAMLP", "GemmaMLP"):
+            mlp = 3 * D * I
+        elif self.mlp_class_name == "LLaMAMoE":
+            mlp = self.n_expert * 3 * D * I + D * self.n_expert
+        else:
+            mlp = 2 * D * I + (I + D if self.bias else 0)
+        norms = 2 * D * (2 if self.bias and self.norm_class_name == "LayerNorm" else 1)
+        return emb + head + L * (attn + mlp + norms) + D
+
+    # ---- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_name(cls, name: str, **overrides: Any) -> "Config":
+        """Look up a named config; accepts exact registry names."""
+        if name in name_to_config:
+            conf_dict = name_to_config[name]
+        else:
+            # allow e.g. "Llama-3-8B-Instruct" to match template "Llama-3-8B{}"
+            matches = [
+                d
+                for d in configs
+                if "{}" in d["name"]
+                and name.startswith(d["name"].split("{}")[0])
+                and name.endswith(d["name"].split("{}")[1])
+            ]
+            if not matches:
+                raise ValueError(f"unknown model name {name!r}")
+            conf_dict = matches[0]
+        conf_dict = dict(conf_dict)
+        conf_dict["name"] = name
+        conf_dict.update(overrides)
+        conf_dict.pop("_template", None)
+        return cls(**conf_dict)
+
+    @classmethod
+    def from_file(cls, path: "str | Path", **overrides: Any) -> "Config":
+        """Load from a `model_config.yaml` (reference `model.py:203-214`) or
+        a JSON config file."""
+        path = Path(path)
+        text = path.read_text()
+        if path.suffix in (".yaml", ".yml"):
+            data = _parse_simple_yaml(text)
+        else:
+            data = json.loads(text)
+        data.update(overrides)
+        known = {f.name for f in dataclasses.fields(cls)}
+        data = {k: v for k, v in data.items() if k in known}
+        return cls(**data)
+
+    @classmethod
+    def from_checkpoint(cls, ckpt_dir: "str | Path", **overrides: Any) -> "Config":
+        """Load config given a checkpoint directory: `model_config.yaml` if
+        present, else fall back to the registry by directory name
+        (reference `model.py:216-236`)."""
+        ckpt_dir = Path(ckpt_dir)
+        for fname in ("model_config.yaml", "model_config.json", "config.json"):
+            p = ckpt_dir / fname
+            if p.exists():
+                if fname == "config.json":
+                    return cls.from_hf_config(json.loads(p.read_text()), **overrides)
+                return cls.from_file(p, **overrides)
+        return cls.from_name(ckpt_dir.name, **overrides)
+
+    @classmethod
+    def from_hf_config(cls, hf: Dict[str, Any], **overrides: Any) -> "Config":
+        """Ground-truth path: map a HuggingFace `config.json` dict to Config.
+
+        Supports the llama/mistral/mixtral families (the reference's final
+        generation targets litGPT Llama, `convert_hf_checkpoint.py:110-198`),
+        plus gpt2 and gpt_neox for the legacy generations.
+        """
+        mt = hf.get("model_type", "llama")
+        if mt in ("llama", "mistral", "mixtral"):
+            data = dict(
+                name=hf.get("_name_or_path", mt),
+                block_size=hf.get("max_position_embeddings", 4096),
+                vocab_size=hf["vocab_size"],
+                padded_vocab_size=hf["vocab_size"],
+                n_layer=hf["num_hidden_layers"],
+                n_head=hf["num_attention_heads"],
+                n_embd=hf["hidden_size"],
+                n_query_groups=hf.get(
+                    "num_key_value_heads", hf["num_attention_heads"]
+                ),
+                head_size=hf.get("head_dim"),  # Mistral-Nemo etc.: != D // H
+                rotary_percentage=1.0,
+                parallel_residual=False,
+                bias=False,
+                norm_class_name="RMSNorm",
+                norm_eps=hf.get("rms_norm_eps", 1e-5),
+                mlp_class_name="LLaMAMoE" if mt == "mixtral" else "LLaMAMLP",
+                intermediate_size=hf["intermediate_size"],
+                rope_base=int(hf.get("rope_theta", 10000)),
+                tie_embeddings=hf.get("tie_word_embeddings", False),
+            )
+            if mt == "mixtral":
+                data["n_expert"] = hf.get("num_local_experts", 8)
+                data["n_expert_per_token"] = hf.get("num_experts_per_tok", 2)
+        elif mt == "gpt2":
+            data = dict(
+                name=hf.get("_name_or_path", "gpt2"),
+                block_size=hf.get("n_positions", 1024),
+                vocab_size=hf["vocab_size"],
+                padding_multiple=64,
+                n_layer=hf["n_layer"],
+                n_head=hf["n_head"],
+                n_embd=hf["n_embd"],
+                rotary_percentage=0.0,
+                pos_embedding="learned",
+                parallel_residual=False,
+                bias=True,
+                norm_class_name="LayerNorm",
+                norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+                mlp_class_name="GptNeoxMLP",
+                gelu_approximate=(
+                    "tanh"
+                    if hf.get("activation_function", "gelu_new") == "gelu_new"
+                    else "none"
+                ),
+                tie_embeddings=True,
+            )
+        elif mt == "gpt_neox":
+            data = dict(
+                name=hf.get("_name_or_path", "gpt_neox"),
+                block_size=hf.get("max_position_embeddings", 2048),
+                vocab_size=hf["vocab_size"],
+                padded_vocab_size=hf["vocab_size"],
+                n_layer=hf["num_hidden_layers"],
+                n_head=hf["num_attention_heads"],
+                n_embd=hf["hidden_size"],
+                rotary_percentage=hf.get("rotary_pct", 0.25),
+                parallel_residual=hf.get("use_parallel_residual", True),
+                bias=True,
+                norm_class_name="LayerNorm",
+                norm_eps=hf.get("layer_norm_eps", 1e-5),
+                mlp_class_name="GptNeoxMLP",
+                intermediate_size=hf.get("intermediate_size"),
+                rope_base=int(hf.get("rotary_emb_base", 10000)),
+                tie_embeddings=hf.get("tie_word_embeddings", False),
+            )
+        else:
+            raise ValueError(f"unsupported HF model_type {mt!r}")
+        data.update(overrides)
+        return cls(**data)
+
+    # ---- serialization -----------------------------------------------------
+
+    def asdict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("rope_n_elem", None)
+        return d
+
+    def save(self, ckpt_dir: "str | Path") -> None:
+        """Write `model_config.yaml` (reference `utils.py:608-611`)."""
+        ckpt_dir = Path(ckpt_dir)
+        ckpt_dir.mkdir(parents=True, exist_ok=True)
+        lines = []
+        for k, v in self.asdict().items():
+            if isinstance(v, dict):
+                lines.append(f"{k}:")
+                for kk, vv in v.items():
+                    lines.append(f"  {kk}: {_yaml_scalar(vv)}")
+            else:
+                lines.append(f"{k}: {_yaml_scalar(v)}")
+        (ckpt_dir / "model_config.yaml").write_text("\n".join(lines) + "\n")
+
+    def replace(self, **kw: Any) -> "Config":
+        d = self.asdict()
+        d.update(kw)
+        return Config(**d)
+
+
+def _yaml_scalar(v: Any) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return json.dumps(v)
+    if isinstance(v, float):
+        s = repr(v)
+        # YAML 1.1 floats need a dot in the mantissa ("1e-05" parses as str)
+        if "e" in s and "." not in s.split("e")[0]:
+            s = s.replace("e", ".0e")
+        return s
+    return str(v)
+
+
+def _parse_simple_yaml(text: str) -> Dict[str, Any]:
+    """Minimal YAML subset parser for flat `model_config.yaml` files (scalars
+    plus one level of nested dict, which is all litGPT/the reference emit).
+    Avoids a hard pyyaml dependency; uses it when available."""
+    try:
+        import yaml  # type: ignore
+
+        return yaml.safe_load(text)
+    except ImportError:
+        pass
+    out: Dict[str, Any] = {}
+    current: Optional[str] = None
+    for raw in text.splitlines():
+        if not raw.strip() or raw.lstrip().startswith("#"):
+            continue
+        indented = raw.startswith(("  ", "\t"))
+        line = raw.strip()
+        if ":" not in line:
+            continue
+        key, _, val = line.partition(":")
+        key, val = key.strip(), val.strip()
+        if indented and current is not None:
+            out[current][key] = _yaml_value(val)
+        elif val == "":
+            current = key
+            out[key] = {}
+        else:
+            current = None
+            out[key] = _yaml_value(val)
+    return out
+
+
+def _yaml_value(v: str) -> Any:
+    if v in ("null", "~", "None"):
+        return None
+    if v in ("true", "True"):
+        return True
+    if v in ("false", "False"):
+        return False
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    if len(v) >= 2 and v[0] in "'\"" and v[-1] == v[0]:
+        return v[1:-1]
+    return v
+
+
+# ===========================================================================
+# Named-model registry.
+#
+# Capability parity with the reference registry (`src/sub/config.py:175-1669`,
+# ~85 entries across ~20 families).  Entries are dicts (converted lazily by
+# Config.from_name).  Specs come from the public model cards / litGPT.
+# ===========================================================================
+
+configs: List[Dict[str, Any]] = []
+
+
+def _add(entry: Dict[str, Any], variants: Optional[List[str]] = None) -> None:
+    if variants is None:
+        configs.append(entry)
+        return
+    for v in variants:
+        e = copy.deepcopy(entry)
+        e["name"] = entry["name"].format(v)
+        if "hf_config" in e:
+            e["hf_config"] = dict(
+                org=entry["hf_config"]["org"],
+                name=entry["hf_config"]["name"].format(v),
+            )
+        configs.append(e)
+    # keep the template too, so from_name can match novel suffixes
+    t = copy.deepcopy(entry)
+    t["_template"] = True
+    configs.append(t)
+
+
+_llama = dict(
+    rotary_percentage=1.0,
+    parallel_residual=False,
+    bias=False,
+    norm_class_name="RMSNorm",
+    mlp_class_name="LLaMAMLP",
+)
+
+# ---- custom / scratch-trainable (reference NanoLlama, README.md:325-330) --
+_add(
+    dict(
+        name="NanoLlama",
+        hf_config=dict(org="custom", name="NanoLlama"),
+        block_size=2048,
+        vocab_size=32000,
+        padding_multiple=64,
+        n_layer=12,
+        n_head=16,
+        n_embd=1024,
+        n_query_groups=16,
+        norm_eps=1e-5,
+        intermediate_size=5632,
+        **_llama,
+    )
+)
+
+# ---- TinyLlama (reference config.py:1606-1645) ----------------------------
+_add(
+    dict(
+        name="tiny-llama-1.1b{}",
+        hf_config=dict(org="TinyLlama", name="TinyLlama-1.1B{}"),
+        block_size=2048,
+        vocab_size=32000,
+        padding_multiple=64,
+        n_layer=22,
+        n_head=32,
+        n_embd=2048,
+        n_query_groups=4,
+        norm_eps=1e-5,
+        intermediate_size=5632,
+        **_llama,
+    ),
+    variants=["", "-intermediate-step-1431k-3T", "-chat", "-Chat-v1.0"],
+)
+
+# ---- Llama 2 (reference config.py:820-878) --------------------------------
+for size, (L, D, H, G, I) in {
+    "7b": (32, 4096, 32, 32, 11008),
+    "13b": (40, 5120, 40, 40, 13824),
+    "70b": (80, 8192, 64, 8, 28672),
+}.items():
+    _add(
+        dict(
+            name=f"Llama-2-{size}{{}}-hf",
+            hf_config=dict(org="meta-llama", name=f"Llama-2-{size}{{}}-hf"),
+            block_size=4096,
+            vocab_size=32000,
+            padding_multiple=64,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            n_query_groups=G,
+            norm_eps=1e-5,
+            intermediate_size=I,
+            **_llama,
+        ),
+        variants=["", "-chat"],
+    )
+
+# ---- Llama 3 (reference config.py:880-924) --------------------------------
+for size, (L, D, H, G, I) in {
+    "8B": (32, 4096, 32, 8, 14336),
+    "70B": (80, 8192, 64, 8, 28672),
+}.items():
+    _add(
+        dict(
+            name=f"Llama-3-{size}{{}}",
+            hf_config=dict(org="meta-llama", name=f"Meta-Llama-3-{size}{{}}"),
+            block_size=8192,
+            vocab_size=128000,
+            padded_vocab_size=128256,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            n_query_groups=G,
+            norm_eps=1e-5,
+            intermediate_size=I,
+            rope_base=500000,
+            **_llama,
+        ),
+        variants=["", "-Instruct"],
+    )
+
+# ---- CodeLlama (reference config.py:1060-1294) ----------------------------
+for size, (L, D, H, G, I) in {
+    "7b": (32, 4096, 32, 32, 11008),
+    "13b": (40, 5120, 40, 40, 13824),
+    "34b": (48, 8192, 64, 8, 22016),
+    "70b": (80, 8192, 64, 8, 28672),
+}.items():
+    for flavor in ("", "-Python", "-Instruct"):
+        _add(
+            dict(
+                name=f"CodeLlama-{size}{flavor}-hf",
+                hf_config=dict(org="codellama", name=f"CodeLlama-{size}{flavor}-hf"),
+                block_size=16384,
+                vocab_size=32016,
+                padding_multiple=16,
+                n_layer=L,
+                n_head=H,
+                n_embd=D,
+                n_query_groups=G,
+                norm_eps=1e-5,
+                intermediate_size=I,
+                rope_base=1000000,
+                **_llama,
+            )
+        )
+
+# ---- Mistral / Mixtral (reference config.py:1487-1604) --------------------
+_add(
+    dict(
+        name="Mistral-7B-{}v0.1",
+        hf_config=dict(org="mistralai", name="Mistral-7B-{}v0.1"),
+        block_size=4096,  # 32k with sliding window; litGPT caps at 4096
+        vocab_size=32000,
+        padding_multiple=512,
+        n_layer=32,
+        n_head=32,
+        n_embd=4096,
+        n_query_groups=8,
+        norm_eps=1e-5,
+        intermediate_size=14336,
+        **_llama,
+    ),
+    variants=["", "Instruct-"],
+)
+_add(
+    dict(
+        name="Mixtral-8x7B-{}v0.1",
+        hf_config=dict(org="mistralai", name="Mixtral-8x7B-{}v0.1"),
+        block_size=32768,
+        vocab_size=32000,
+        padding_multiple=512,
+        n_layer=32,
+        n_head=32,
+        n_embd=4096,
+        n_query_groups=8,
+        norm_eps=1e-5,
+        intermediate_size=14336,
+        rope_base=1000000,
+        n_expert=8,
+        n_expert_per_token=2,
+        rotary_percentage=1.0,
+        parallel_residual=False,
+        bias=False,
+        norm_class_name="RMSNorm",
+        mlp_class_name="LLaMAMoE",
+    ),
+    variants=["", "Instruct-"],
+)
+for ver, vocab in (("v0.2", 32000), ("v0.3", 32768)):
+    _add(
+        dict(
+            name=f"Mistral-7B-{{}}{ver}",
+            hf_config=dict(org="mistralai", name=f"Mistral-7B-{{}}{ver}"),
+            block_size=32768,
+            vocab_size=vocab,
+            padding_multiple=512,
+            n_layer=32,
+            n_head=32,
+            n_embd=4096,
+            n_query_groups=8,
+            norm_eps=1e-5,
+            intermediate_size=14336,
+            rope_base=1000000,
+            **_llama,
+        ),
+        variants=["", "Instruct-"],
+    )
+
+# ---- Pythia (reference config.py:283-397) ---------------------------------
+for size, (L, D, H) in {
+    "14m": (6, 128, 4),
+    "31m": (6, 256, 8),
+    "70m": (6, 512, 8),
+    "160m": (12, 768, 12),
+    "410m": (24, 1024, 16),
+    "1b": (16, 2048, 8),
+    "1.4b": (24, 2048, 16),
+    "2.8b": (32, 2560, 32),
+    "6.9b": (32, 4096, 32),
+    "12b": (36, 5120, 40),
+}.items():
+    _add(
+        dict(
+            name=f"pythia-{size}{{}}",
+            hf_config=dict(org="EleutherAI", name=f"pythia-{size}{{}}"),
+            block_size=2048,
+            vocab_size=50254,
+            padding_multiple=128,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            rotary_percentage=0.25,
+            parallel_residual=True,
+            bias=True,
+            norm_class_name="LayerNorm",
+            mlp_class_name="GptNeoxMLP",
+        ),
+        variants=["", "-deduped"],
+    )
+
+# ---- Dolly v2 (pythia-based, reference config.py:399-428) -----------------
+for size, (L, D, H) in {"3b": (32, 2560, 32), "7b": (32, 4096, 32), "12b": (36, 5120, 40)}.items():
+    _add(
+        dict(
+            name=f"dolly-v2-{size}",
+            hf_config=dict(org="databricks", name=f"dolly-v2-{size}"),
+            block_size=2048,
+            vocab_size=50254,
+            padded_vocab_size=50280,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            rotary_percentage=0.25,
+            parallel_residual=True,
+            bias=True,
+            norm_class_name="LayerNorm",
+            mlp_class_name="GptNeoxMLP",
+        )
+    )
+
+# ---- RedPajama-INCITE (gpt-neox arch, reference config.py:430-470) --------
+for nm, (L, D, H) in {
+    "RedPajama-INCITE-{}-3B-v1": (32, 2560, 32),
+    "RedPajama-INCITE-7B-{}": (32, 4096, 32),
+}.items():
+    _add(
+        dict(
+            name=nm,
+            hf_config=dict(org="togethercomputer", name=nm),
+            block_size=2048,
+            vocab_size=50254,
+            padding_multiple=256,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            rotary_percentage=1.0,
+            parallel_residual=False,
+            bias=True,
+            norm_class_name="LayerNorm",
+            mlp_class_name="GptNeoxMLP",
+        ),
+        variants=["Base", "Chat", "Instruct"],
+    )
+
+# ---- Falcon (reference config.py:472-538) ---------------------------------
+_add(
+    dict(
+        name="falcon-7b{}",
+        hf_config=dict(org="tiiuae", name="falcon-7b{}"),
+        block_size=2048,
+        vocab_size=65024,
+        padded_vocab_size=65024,
+        n_layer=32,
+        n_head=71,
+        n_embd=4544,
+        n_query_groups=1,
+        rotary_percentage=1.0,
+        parallel_residual=True,
+        bias=False,
+        shared_attention_norm=True,
+        norm_class_name="LayerNorm",
+        mlp_class_name="GptNeoxMLP",
+    ),
+    variants=["", "-instruct"],
+)
+_add(
+    dict(
+        name="falcon-40b{}",
+        hf_config=dict(org="tiiuae", name="falcon-40b{}"),
+        block_size=2048,
+        vocab_size=65024,
+        padded_vocab_size=65024,
+        n_layer=60,
+        n_head=128,
+        n_embd=8192,
+        n_query_groups=8,
+        rotary_percentage=1.0,
+        parallel_residual=True,
+        bias=False,
+        norm_class_name="LayerNorm",
+        mlp_class_name="GptNeoxMLP",
+    ),
+    variants=["", "-instruct"],
+)
+_add(
+    dict(
+        name="falcon-180B{}",
+        hf_config=dict(org="tiiuae", name="falcon-180B{}"),
+        block_size=2048,
+        vocab_size=65024,
+        padded_vocab_size=65024,
+        n_layer=80,
+        n_head=232,
+        n_embd=14848,
+        n_query_groups=8,
+        rotary_percentage=1.0,
+        parallel_residual=True,
+        bias=False,
+        norm_class_name="LayerNorm",
+        mlp_class_name="GptNeoxMLP",
+    ),
+    variants=["", "-chat"],
+)
+
+# ---- StableLM (reference config.py:180-280) -------------------------------
+for nm, (L, D, H, bs) in {
+    "stablelm-base-alpha-3b": (16, 4096, 32, 4096),
+    "stablelm-base-alpha-7b": (16, 6144, 48, 4096),
+    "stablelm-tuned-alpha-3b": (16, 4096, 32, 4096),
+    "stablelm-tuned-alpha-7b": (16, 6144, 48, 4096),
+}.items():
+    _add(
+        dict(
+            name=nm,
+            hf_config=dict(org="stabilityai", name=nm),
+            block_size=bs,
+            vocab_size=50254,
+            padded_vocab_size=50432,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            rotary_percentage=0.25,
+            parallel_residual=True,
+            bias=True,
+            norm_class_name="LayerNorm",
+            mlp_class_name="GptNeoxMLP",
+        )
+    )
+for nm in ("stablelm-3b-4e1t", "stablelm-zephyr-3b"):
+    _add(
+        dict(
+            name=nm,
+            hf_config=dict(org="stabilityai", name=nm),
+            block_size=4096,
+            vocab_size=50254,
+            padding_multiple=512,
+            n_layer=32,
+            n_head=32,
+            n_embd=2560,
+            parallel_residual=False,
+            bias=False,
+            rotary_percentage=0.25,
+            norm_class_name="LayerNorm",
+            mlp_class_name="LLaMAMLP",
+            intermediate_size=6912,
+        )
+    )
+
+# ---- OpenLLaMA / Vicuna / LongChat / Nous-Hermes / Platypus ---------------
+for nm, (org, (L, D, H, I, bs)) in {
+    "open_llama_3b": ("openlm-research", (26, 3200, 32, 8640, 2048)),
+    "open_llama_7b": ("openlm-research", (32, 4096, 32, 11008, 2048)),
+    "open_llama_13b": ("openlm-research", (40, 5120, 40, 13824, 2048)),
+    "vicuna-7b-v1.3": ("lmsys", (32, 4096, 32, 11008, 2048)),
+    "vicuna-13b-v1.3": ("lmsys", (40, 5120, 40, 13824, 2048)),
+    "vicuna-33b-v1.3": ("lmsys", (60, 6656, 52, 17920, 2048)),
+    "vicuna-7b-v1.5": ("lmsys", (32, 4096, 32, 11008, 4096)),
+    "vicuna-7b-v1.5-16k": ("lmsys", (32, 4096, 32, 11008, 16384)),
+    "vicuna-13b-v1.5": ("lmsys", (40, 5120, 40, 13824, 4096)),
+    "vicuna-13b-v1.5-16k": ("lmsys", (40, 5120, 40, 13824, 16384)),
+    "longchat-7b-16k": ("lmsys", (32, 4096, 32, 11008, 16384)),
+    "longchat-13b-16k": ("lmsys", (40, 5120, 40, 13824, 16384)),
+    "Nous-Hermes-llama-2-7b": ("NousResearch", (32, 4096, 32, 11008, 4096)),
+    "Nous-Hermes-13b": ("NousResearch", (40, 5120, 40, 13824, 2048)),
+    "Nous-Hermes-Llama2-13b": ("NousResearch", (40, 5120, 40, 13824, 4096)),
+    "Platypus-30B": ("garage-bAInd", (60, 6656, 52, 17920, 2048)),
+    "Platypus2-7B": ("garage-bAInd", (32, 4096, 32, 11008, 4096)),
+    "Platypus2-13B": ("garage-bAInd", (40, 5120, 40, 13824, 4096)),
+    "Platypus2-70B": ("garage-bAInd", (80, 8192, 64, 28672, 4096)),
+    "FreeWilly2": ("stabilityai", (80, 8192, 64, 28672, 4096)),
+    "LLaMA-2-7B-32K": ("togethercomputer", (32, 4096, 32, 11008, 32768)),
+}.items():
+    groups = 8 if (L, D) in ((80, 8192),) else H
+    _add(
+        dict(
+            name=nm,
+            hf_config=dict(org=org, name=nm),
+            block_size=bs,
+            vocab_size=32000,
+            padding_multiple=64,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            n_query_groups=groups,
+            norm_eps=1e-6 if "open_llama" in nm else 1e-5,
+            intermediate_size=I,
+            **_llama,
+        )
+    )
+
+# ---- Phi (reference config.py:1451-1485) ----------------------------------
+_add(
+    dict(
+        name="phi-1_5",
+        hf_config=dict(org="microsoft", name="phi-1_5"),
+        block_size=2048,
+        vocab_size=50257,
+        padded_vocab_size=51200,
+        n_layer=24,
+        n_head=32,
+        n_embd=2048,
+        rotary_percentage=0.5,
+        shared_attention_norm=True,
+        parallel_residual=True,
+        bias=True,
+        lm_head_bias=True,
+        norm_class_name="LayerNorm",
+        mlp_class_name="GptNeoxMLP",
+        gelu_approximate="tanh",
+    )
+)
+_add(
+    dict(
+        name="phi-2",
+        hf_config=dict(org="microsoft", name="phi-2"),
+        block_size=2048,
+        vocab_size=50257,
+        padded_vocab_size=51200,
+        n_layer=32,
+        n_head=32,
+        n_embd=2560,
+        rotary_percentage=0.4,
+        shared_attention_norm=True,
+        parallel_residual=True,
+        bias=True,
+        lm_head_bias=True,
+        norm_class_name="LayerNorm",
+        mlp_class_name="GptNeoxMLP",
+        gelu_approximate="tanh",
+    )
+)
+
+# ---- Gemma / CodeGemma (reference config.py:930-1007) ---------------------
+for nm, (L, D, H, G, hs, I) in {
+    "Gemma-2b": (18, 2048, 8, 1, 256, 16384),
+    "Gemma-2b-it": (18, 2048, 8, 1, 256, 16384),
+    "Gemma-7b": (28, 3072, 16, 16, 256, 24576),
+    "Gemma-7b-it": (28, 3072, 16, 16, 256, 24576),
+    "CodeGemma-7b-it": (28, 3072, 16, 16, 256, 24576),
+}.items():
+    _add(
+        dict(
+            name=nm,
+            hf_config=dict(org="google", name=nm.lower()),
+            block_size=8192,
+            vocab_size=256000,
+            padded_vocab_size=256000,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            n_query_groups=G,
+            head_size=hs,
+            rotary_percentage=1.0,
+            parallel_residual=False,
+            bias=False,
+            norm_class_name="RMSNorm",
+            norm_eps=1e-6,
+            mlp_class_name="GemmaMLP",
+            gelu_approximate="tanh",
+            intermediate_size=I,
+            scale_embeddings=True,
+            tie_embeddings=True,
+            rmsnorm_add_unit_offset=True,
+        )
+    )
+
+# ---- Danube2 (reference config.py:1009-1034) ------------------------------
+_add(
+    dict(
+        name="Danube2-1.8b-chat",
+        hf_config=dict(org="h2oai", name="h2o-danube2-1.8b-chat"),
+        block_size=4096,
+        vocab_size=32000,
+        padding_multiple=64,
+        n_layer=24,
+        n_head=32,
+        n_embd=2560,
+        n_query_groups=8,
+        norm_eps=1e-5,
+        intermediate_size=6912,
+        rope_base=10000,
+        **_llama,
+    )
+)
+
+# ---- Function-calling Llama 2 (reference config.py:1643-1662) -------------
+_add(
+    dict(
+        name="Llama-2-7b-chat-hf-function-calling-v2",
+        hf_config=dict(org="Trelis", name="Llama-2-7b-chat-hf-function-calling-v2"),
+        block_size=4096,
+        vocab_size=32000,
+        padding_multiple=64,
+        n_layer=32,
+        n_head=32,
+        n_embd=4096,
+        norm_eps=1e-5,
+        intermediate_size=11008,
+        **_llama,
+    )
+)
+
+# ---- GPT-2 family (legacy generation parity, old/GPT2/sub/model.py) -------
+for nm, (L, D, H) in {
+    "gpt2": (12, 768, 12),
+    "gpt2-medium": (24, 1024, 16),
+    "gpt2-large": (36, 1280, 20),
+    "gpt2-xl": (48, 1600, 25),
+}.items():
+    _add(
+        dict(
+            name=nm,
+            hf_config=dict(org="openai-community", name=nm),
+            block_size=1024,
+            vocab_size=50257,
+            padding_multiple=64,
+            n_layer=L,
+            n_head=H,
+            n_embd=D,
+            rotary_percentage=0.0,
+            pos_embedding="learned",
+            parallel_residual=False,
+            bias=True,
+            norm_class_name="LayerNorm",
+            mlp_class_name="GptNeoxMLP",
+            gelu_approximate="tanh",  # HF gpt2 uses gelu_new
+            tie_embeddings=True,
+        )
+    )
+
+name_to_config: Dict[str, Dict[str, Any]] = {
+    d["name"]: d for d in configs if "_template" not in d
+}

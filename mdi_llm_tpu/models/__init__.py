@@ -1,0 +1,25 @@
+"""Model library: functional transformer, parameter init, KV caches."""
+
+from mdi_llm_tpu.models.transformer import (
+    forward,
+    embed,
+    head,
+    run_blocks,
+    init_params,
+    init_kv_cache,
+    count_params,
+    cast_params,
+    slice_blocks,
+)
+
+__all__ = [
+    "forward",
+    "embed",
+    "head",
+    "run_blocks",
+    "init_params",
+    "init_kv_cache",
+    "count_params",
+    "cast_params",
+    "slice_blocks",
+]

@@ -1,0 +1,425 @@
+"""Decoder-only transformer as pure functions over a parameter pytree.
+
+TPU-native redesign of the reference model stack
+(`/root/reference/src/sub/model.py:276-981` `GPT`/`Block`/`CausalSelfAttention`/
+MLPs/`KVCache`, and `/root/reference/src/sub/submodels.py` `StarterNode`/
+`SecondaryNode`).  Key re-design decisions:
+
+- **Layer-stacked parameters**: every per-layer leaf carries a leading layer
+  axis and the block stack runs under `lax.scan`, so XLA compiles ONE block
+  and reuses it — compile time is O(1) in depth, and slicing the leading axis
+  yields a pipeline stage's parameters (the TPU analog of the reference's
+  `split_parameters`, utils.py:241-385).
+- **Functional KV cache**: a `(L, B, G, S, hs)` array pair threaded through
+  the scan and updated with `dynamic_update_slice` (≡ `KVCache.index_copy_`,
+  model.py:918-933) — donated under jit so decode is in-place in HBM.
+- **Position-based masking**: queries carry absolute positions; no (S, S)
+  mask cache materialization (cf. `build_mask_cache`, model.py:940-947).
+- **Three-phase API** (`embed` / `run_blocks` / `head`) replaces the
+  reference's two-phase `StarterNode.forward(first_pass=...)`
+  (submodels.py:170-220): stage 0 of a pipeline = embed + run_blocks, last
+  hop output re-enters stage 0 through `head`.
+
+All matmuls hit the MXU in the params' dtype (bf16 by default) with f32
+softmax/norm accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.ops.attention import multihead_attention
+from mdi_llm_tpu.ops.norms import layer_norm, rms_norm
+from mdi_llm_tpu.ops.rope import apply_rope, build_rope_cache
+
+Params = Dict[str, Any]
+KVCache = Dict[str, jnp.ndarray]  # {"k": (L,B,G,S,hs), "v": (L,B,G,S,hs)}
+
+
+# ---------------------------------------------------------------------------
+# Linear helpers (torch layout: weight (out, in)) so converted HF/litGPT
+# checkpoints drop in without transposition bookkeeping.
+# ---------------------------------------------------------------------------
+
+
+def linear(x: jnp.ndarray, p: Params) -> jnp.ndarray:
+    y = jnp.einsum("...i,oi->...o", x, p["weight"])
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _norm(cfg: Config, x: jnp.ndarray, p: Params) -> jnp.ndarray:
+    if cfg.norm_class_name == "RMSNorm":
+        return rms_norm(
+            x, p["weight"], cfg.norm_eps, add_unit_offset=cfg.rmsnorm_add_unit_offset
+        )
+    return layer_norm(x, p["weight"], p.get("bias"), cfg.norm_eps)
+
+
+def _gelu(cfg: Config, x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.gelu(x, approximate=cfg.gelu_approximate == "tanh")
+
+
+# ---------------------------------------------------------------------------
+# MLP variants (reference model.py:782-853)
+# ---------------------------------------------------------------------------
+
+
+def mlp_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    kind = cfg.mlp_class_name
+    if kind == "GptNeoxMLP":
+        return linear(_gelu(cfg, linear(x, p["fc"])), p["proj"])
+    if kind == "LLaMAMLP":
+        return linear(jax.nn.silu(linear(x, p["fc_1"])) * linear(x, p["fc_2"]), p["proj"])
+    if kind == "GemmaMLP":
+        return linear(_gelu(cfg, linear(x, p["fc_1"])) * linear(x, p["fc_2"]), p["proj"])
+    if kind == "LLaMAMoE":
+        return moe_forward(cfg, p, x)
+    raise ValueError(f"unknown mlp_class_name {kind!r}")
+
+
+def moe_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Top-k routed mixture of experts (reference `LLaMAMoE`,
+    model.py:823-853).
+
+    Dense formulation: every expert runs on every token and the router's
+    top-k weights (renormalized over the selected experts) zero out the rest.
+    On TPU this keeps shapes static and the MXU busy; for large E an
+    expert-parallel sharded variant lives in `parallel/expert.py`.
+    """
+    E = cfg.n_expert
+    router = jnp.einsum("...i,ei->...e", x, p["gate"]["weight"]).astype(jnp.float32)
+    probs = jax.nn.softmax(router, axis=-1)  # (..., E)
+    topv, topi = jax.lax.top_k(probs, cfg.n_expert_per_token)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # scatter the normalized top-k weights back to a dense (..., E) table
+    onehot = jax.nn.one_hot(topi, E, dtype=probs.dtype)  # (..., k, E)
+    dense_w = jnp.einsum("...k,...ke->...e", topv, onehot)  # (..., E)
+
+    # expert params have a leading E axis: fc_1 (E, I, D) etc.
+    h1 = jnp.einsum("...d,eid->...ei", x, p["experts"]["fc_1"]["weight"])
+    h2 = jnp.einsum("...d,eid->...ei", x, p["experts"]["fc_2"]["weight"])
+    h = jax.nn.silu(h1) * h2
+    out = jnp.einsum("...ei,edi->...ed", h, p["experts"]["proj"]["weight"])
+    return jnp.einsum("...ed,...e->...d", out, dense_w.astype(out.dtype)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------
+
+
+def _split_qkv(cfg: Config, qkv: jnp.ndarray):
+    """Un-interleave the fused litGPT QKV projection output.
+
+    litGPT packs per KV-group [q * q_per_kv, k, v] (reference
+    model.py:686-702); returns q (B,T,n_head,hs), k/v (B,T,G,hs).
+    """
+    B, T, _ = qkv.shape
+    G = cfg.n_query_groups
+    q_per_kv = cfg.n_head // G
+    hs = cfg.head_size
+    qkv = qkv.reshape(B, T, G, q_per_kv + 2, hs)
+    q = qkv[:, :, :, :q_per_kv, :].reshape(B, T, cfg.n_head, hs)
+    k = qkv[:, :, :, q_per_kv, :]
+    v = qkv[:, :, :, q_per_kv + 1, :]
+    return q, k, v
+
+
+def attention_forward(
+    cfg: Config,
+    p: Params,
+    x: jnp.ndarray,  # (B, T, D)
+    pos: jnp.ndarray,  # (B, T) absolute positions
+    cos: jnp.ndarray,  # (B, T, rope_n_elem) pre-gathered for these positions
+    sin: jnp.ndarray,
+    k_cache: Optional[jnp.ndarray],  # (B, G, S, hs) or None
+    v_cache: Optional[jnp.ndarray],
+    input_pos: Optional[jnp.ndarray],  # (B,) write offset into the cache
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    B, T, D = x.shape
+    qkv = linear(x, p["qkv"])
+    q, k, v = _split_qkv(cfg, qkv)
+    # (B, heads, T, hs)
+    q = q.swapaxes(1, 2)
+    k = k.swapaxes(1, 2)
+    v = v.swapaxes(1, 2)
+
+    n_elem = cfg.rope_n_elem
+    if n_elem > 0:
+        cos_b = cos[:, None, :, :]
+        sin_b = sin[:, None, :, :]
+        q = jnp.concatenate(
+            [apply_rope(q[..., :n_elem], cos_b, sin_b), q[..., n_elem:]], axis=-1
+        )
+        k = jnp.concatenate(
+            [apply_rope(k[..., :n_elem], cos_b, sin_b), k[..., n_elem:]], axis=-1
+        )
+
+    if k_cache is not None:
+        # scatter this chunk into the cache at each sample's offset
+        def upd(cache, new, off):
+            return jax.lax.dynamic_update_slice(cache, new, (0, off, 0))
+
+        k_cache = jax.vmap(upd)(k_cache, k, input_pos)
+        v_cache = jax.vmap(upd)(v_cache, v, input_pos)
+        k_att, v_att = k_cache, v_cache
+        kv_valid = input_pos + T  # (B,)
+        k_pos = None  # cache slot j holds absolute position j
+    else:
+        k_att, v_att = k, v
+        kv_valid = None
+        k_pos = pos  # uncached chunk: keys sit at the query positions
+
+    # litGPT scales by 1/sqrt(head_size) (model.py:738-751)
+    y = multihead_attention(q, k_att, v_att, pos, kv_valid, k_pos=k_pos)
+    y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size)
+    return linear(y, p["proj"]), k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# Block + scan over the stack
+# ---------------------------------------------------------------------------
+
+
+def block_forward(
+    cfg: Config,
+    p: Params,
+    x: jnp.ndarray,
+    pos: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    k_cache: Optional[jnp.ndarray],
+    v_cache: Optional[jnp.ndarray],
+    input_pos: Optional[jnp.ndarray],
+):
+    """One transformer block (reference `Block`, model.py:576-629), both the
+    parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms."""
+    n1 = _norm(cfg, x, p["norm_1"])
+    att, k_cache, v_cache = attention_forward(
+        cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos
+    )
+    if cfg.parallel_residual:
+        n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
+        x = x + att + mlp_forward(cfg, p["mlp"], n2)
+    else:
+        x = x + att
+        x = x + mlp_forward(cfg, p["mlp"], _norm(cfg, x, p["norm_2"]))
+    return x, k_cache, v_cache
+
+
+def run_blocks(
+    cfg: Config,
+    blocks: Params,  # stacked: every leaf has leading axis L_stage
+    x: jnp.ndarray,  # (B, T, D)
+    pos: jnp.ndarray,  # (B, T)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    kv: Optional[KVCache] = None,  # k/v: (L_stage, B, G, S, hs)
+    input_pos: Optional[jnp.ndarray] = None,  # (B,)
+) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    """Scan the block stack. One compiled block, L iterations."""
+
+    if kv is None:
+
+        def body(carry, layer_p):
+            y, _, _ = block_forward(
+                cfg, layer_p, carry, pos, cos, sin, None, None, input_pos
+            )
+            return y, None
+
+        x, _ = jax.lax.scan(body, x, blocks)
+        return x, None
+
+    def body(carry, xs):
+        layer_p, k_c, v_c = xs
+        y, k_c, v_c = block_forward(
+            cfg, layer_p, carry, pos, cos, sin, k_c, v_c, input_pos
+        )
+        return y, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (blocks, kv["k"], kv["v"]))
+    return x, {"k": k_new, "v": v_new}
+
+
+# ---------------------------------------------------------------------------
+# Embedding / head phases
+# ---------------------------------------------------------------------------
+
+
+def embed(cfg: Config, params: Params, tokens: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """Token (+ learned position, for the GPT-2 generation) embedding."""
+    x = jnp.take(params["wte"]["weight"], tokens, axis=0)
+    if cfg.scale_embeddings:  # Gemma (model.py:390-391)
+        x = x * jnp.asarray(cfg.n_embd**0.5, dtype=x.dtype)
+    if cfg.pos_embedding == "learned":
+        x = x + jnp.take(params["wpe"]["weight"], pos, axis=0)
+    return x
+
+
+def head(cfg: Config, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + LM head (the reference starter's `first_pass=False` path,
+    submodels.py:203-218)."""
+    x = _norm(cfg, x, params["ln_f"])
+    w = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("...d,vd->...v", x, w["weight"])
+    if cfg.lm_head_bias:
+        logits = logits + params["lm_head"]["bias"]
+    return logits
+
+
+def forward(
+    cfg: Config,
+    params: Params,
+    tokens: jnp.ndarray,  # (B, T) int32
+    input_pos: jnp.ndarray,  # (B,) start offset of this chunk
+    kv: Optional[KVCache] = None,
+    rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
+
+    Works for prefill (T = prompt chunk) and decode (T = 1) alike; the same
+    traced function is reused whenever shapes match (shape-bucketing lives in
+    `generation.py`).
+    """
+    B, T = tokens.shape
+    pos = input_pos[:, None] + jnp.arange(T, dtype=input_pos.dtype)[None, :]
+    if rope is None:
+        rope = get_rope_cache(cfg)
+    cos = jnp.take(rope[0], pos, axis=0)
+    sin = jnp.take(rope[1], pos, axis=0)
+    x = embed(cfg, params, tokens, pos)
+    x, kv = run_blocks(cfg, params["blocks"], x, pos, cos, sin, kv, input_pos)
+    return head(cfg, params, x), kv
+
+
+@functools.lru_cache(maxsize=16)
+def _rope_cache_memo(block_size: int, n_elem: int, base: int, ratio: int):
+    return build_rope_cache(block_size, n_elem, base, ratio)
+
+
+def get_rope_cache(cfg: Config, seq_len: Optional[int] = None):
+    """Memoized (cos, sin) tables for a config — eager decode loops would
+    otherwise recompute block_size×n_elem trig tables every token.
+
+    Positions beyond the table length would silently clip under jnp.take;
+    generation code checks lengths host-side before stepping."""
+    return _rope_cache_memo(
+        seq_len or cfg.block_size, cfg.rope_n_elem, cfg.rope_base, cfg.rope_condense_ratio
+    )
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialization (scratch training)
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    cfg: Config, key: jax.Array, dtype=jnp.float32, n_layer: Optional[int] = None
+) -> Params:
+    """GPT-NeoX-style init (reference train.py:35-55): normal(0, 0.02)
+    everywhere, output projections scaled by 1/sqrt(2*n_layer)."""
+    L = cfg.n_layer if n_layer is None else n_layer
+    D, V = cfg.n_embd, cfg.padded_vocab_size
+    I = cfg.intermediate_size
+    std = 0.02
+    proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5
+    keys = iter(jax.random.split(key, 64))
+
+    def w(shape, s=std):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(dtype)
+
+    def lin(out_d, in_d, s=std, bias=cfg.bias):
+        p = {"weight": w((L, out_d, in_d), s)}
+        if bias:
+            p["bias"] = jnp.zeros((L, out_d), dtype)
+        return p
+
+    def norm_p():
+        p = {"weight": jnp.ones((L, D), dtype)}
+        if cfg.norm_class_name == "LayerNorm" and cfg.bias:
+            p["bias"] = jnp.zeros((L, D), dtype)
+        return p
+
+    attn = {
+        "qkv": lin(cfg.qkv_size, D),
+        "proj": lin(D, cfg.attn_out_size, proj_std),
+    }
+    if cfg.mlp_class_name == "GptNeoxMLP":
+        mlp = {"fc": lin(I, D), "proj": lin(D, I, proj_std)}
+    elif cfg.mlp_class_name in ("LLaMAMLP", "GemmaMLP"):
+        mlp = {
+            "fc_1": lin(I, D, bias=False),
+            "fc_2": lin(I, D, bias=False),
+            "proj": lin(D, I, proj_std, bias=False),
+        }
+    else:  # LLaMAMoE
+        E = cfg.n_expert
+        mlp = {
+            "gate": {"weight": w((L, E, D))},
+            "experts": {
+                "fc_1": {"weight": w((L, E, I, D))},
+                "fc_2": {"weight": w((L, E, I, D))},
+                "proj": {"weight": w((L, E, D, I), proj_std)},
+            },
+        }
+    blocks = {"norm_1": norm_p(), "attn": attn, "mlp": mlp}
+    if not cfg.shared_attention_norm:
+        blocks["norm_2"] = norm_p()
+
+    params: Params = {
+        "wte": {"weight": w((V, D))},
+        "blocks": blocks,
+        "ln_f": {
+            "weight": jnp.ones((D,), dtype),
+            **(
+                {"bias": jnp.zeros((D,), dtype)}
+                if cfg.norm_class_name == "LayerNorm" and cfg.bias
+                else {}
+            ),
+        },
+    }
+    if cfg.pos_embedding == "learned":
+        params["wpe"] = {"weight": w((cfg.block_size, D))}
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"weight": w((V, D))}
+        if cfg.lm_head_bias:
+            params["lm_head"]["bias"] = jnp.zeros((V,), dtype)
+    elif cfg.lm_head_bias:
+        params["lm_head"] = {"bias": jnp.zeros((V,), dtype)}
+    return params
+
+
+def init_kv_cache(
+    cfg: Config,
+    batch_size: int,
+    max_seq_length: int,
+    dtype=jnp.bfloat16,
+    n_layer: Optional[int] = None,
+) -> KVCache:
+    """Preallocated zero cache (≡ reference `GPT.set_kv_cache`,
+    model.py:423-447): k/v of shape (L, B, G, S, hs)."""
+    L = cfg.n_layer if n_layer is None else n_layer
+    shape = (L, batch_size, cfg.n_query_groups, max_seq_length, cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def count_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def cast_params(params: Params, dtype) -> Params:
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), params)
+
+
+def slice_blocks(blocks: Params, start: int, stop: int) -> Params:
+    """Take layers [start, stop) from a stacked block pytree — the TPU-native
+    `split_parameters` (reference utils.py:241-385): no renaming, just a
+    leading-axis slice."""
+    return jax.tree_util.tree_map(lambda x: x[start:stop], blocks)

@@ -1,0 +1,17 @@
+"""TPU-native core ops: RoPE, norms, attention, KV cache, sampling."""
+
+from mdi_llm_tpu.ops.rope import build_rope_cache, apply_rope
+from mdi_llm_tpu.ops.norms import rms_norm, layer_norm
+from mdi_llm_tpu.ops.attention import multihead_attention
+from mdi_llm_tpu.ops.sampling import sample, sample_top_p, logits_to_probs
+
+__all__ = [
+    "build_rope_cache",
+    "apply_rope",
+    "rms_norm",
+    "layer_norm",
+    "multihead_attention",
+    "sample",
+    "sample_top_p",
+    "logits_to_probs",
+]

@@ -1,0 +1,73 @@
+"""Causal multi-head / grouped-query attention.
+
+TPU-native replacement for the reference's `CausalSelfAttention.scaled_dot_product_attention`
+(`/root/reference/src/sub/model.py:632-779`, which delegates to torch SDPA).
+Here attention is a pure function over (q, k, v) designed so that XLA fuses
+the softmax chain and maps the two matmuls onto the MXU; a Pallas
+flash-attention kernel (`mdi_llm_tpu.ops.flash`) can be swapped in for long
+sequences.
+
+Masking model: queries carry absolute positions `q_pos` (B, Tq); keys are a
+cache of length S where entries at absolute position `k_pos[j] = j` are valid
+iff `j <= q_pos[i]` and `j < kv_len`.  This one rule covers prefill
+(q_pos = arange(T)) and batched decode (q_pos = per-sample input_pos,
+Tq == 1) without separate mask cache machinery (reference builds an explicit
+(S, S) bool mask cache, model.py:940-947).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def multihead_attention(
+    q: jnp.ndarray,  # (B, n_head, Tq, hs)
+    k: jnp.ndarray,  # (B, n_query_groups, Tk, hs)
+    v: jnp.ndarray,  # (B, n_query_groups, Tk, hs)
+    q_pos: jnp.ndarray,  # (B, Tq) absolute positions of the queries
+    kv_valid_len: Optional[jnp.ndarray] = None,  # (B,) number of valid cache slots
+    k_pos: Optional[jnp.ndarray] = None,  # (B, Tk) absolute key positions
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal attention with implicit GQA (heads grouped over KV heads).
+
+    `k_pos` defaults to cache-slot indexing (absolute position j stored in
+    slot j); pass it explicitly for uncached chunks at a nonzero offset.
+    Returns (B, n_head, Tq, hs).
+    """
+    B, n_head, Tq, hs = q.shape
+    _, n_groups, Tk, _ = k.shape
+    if scale is None:
+        scale = 1.0 / (hs**0.5)
+
+    q_per_kv = n_head // n_groups
+    # fold the query heads into groups: (B, G, q_per_kv, Tq, hs)
+    qg = q.reshape(B, n_groups, q_per_kv, Tq, hs)
+
+    # logits in f32 for numerical stability on bf16 inputs
+    logits = jnp.einsum(
+        "bgqth,bgsh->bgqts", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+
+    # causal + validity mask from absolute positions
+    if k_pos is None:
+        k_pos = jnp.broadcast_to(jnp.arange(Tk, dtype=q_pos.dtype), (B, Tk))
+    mask = k_pos[:, None, :] <= q_pos[:, :, None]  # (B, Tq, Tk)
+    if kv_valid_len is not None:
+        slot = jnp.arange(Tk, dtype=q_pos.dtype)
+        mask = mask & (slot[None, None, :] < kv_valid_len[:, None, None])
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    probs = jnp.exp(
+        logits - jnp.max(logits, axis=-1, keepdims=True)
+    )
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    probs = probs.astype(v.dtype)
+
+    out = jnp.einsum("bgqts,bgsh->bgqth", probs, v)
+    return out.reshape(B, n_head, Tq, hs)

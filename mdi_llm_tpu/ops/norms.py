@@ -1,0 +1,46 @@
+"""Normalization layers as pure functions.
+
+Semantics: RMSNorm matches reference `RMSNorm` (model.py:950-981) including
+the Gemma unit-offset variant (weight + 1); LayerNorm matches torch
+`nn.LayerNorm` with optional bias.  Accumulation is always float32 (TPU
+bf16-safe), cast back to the input dtype at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    add_unit_offset: bool = False,
+) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    norm = xf * jax_rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    w = weight.astype(jnp.float32)
+    if add_unit_offset:
+        w = 1.0 + w
+    return (norm * w).astype(x.dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax_rsqrt(var + eps) * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def jax_rsqrt(x: jnp.ndarray) -> jnp.ndarray:
+    return 1.0 / jnp.sqrt(x)

@@ -1,0 +1,72 @@
+"""Rotary position embeddings.
+
+Semantics match the reference (`/root/reference/src/sub/model.py:856-891`,
+litGPT convention): frequencies over the first `rope_n_elem` channels of each
+head, the rotated half is `[-x2, x1]` with the head dim split in two
+contiguous halves.  Implemented as pure jnp functions; the cos/sin cache is a
+plain array pair that jit treats as ordinary operands, so position offsets are
+dynamic (gathered per token) rather than baked into the trace.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def build_rope_cache(
+    seq_len: int,
+    n_elem: int,
+    base: int = 10000,
+    condense_ratio: int = 1,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Return (cos, sin), each of shape (seq_len, n_elem).
+
+    Equivalent computation to reference `build_rope_cache` (model.py:856-878):
+    theta_i = 1 / base^(2i/n_elem), positions optionally condensed.
+
+    Computed in NumPy on the host: the tables are static for a config, so
+    they must be constants (cacheable, safe to memoize) rather than traced
+    values — inside jit they fold into the executable.
+    """
+    if n_elem <= 0:
+        z = np.zeros((seq_len, 0), dtype=dtype)
+        return z, z
+    theta = 1.0 / (base ** (np.arange(0, n_elem, 2, dtype=np.float32) / n_elem))
+    pos = np.arange(seq_len, dtype=np.float32) / condense_ratio
+    idx_theta = np.outer(pos, theta)  # (S, n_elem//2)
+    # duplicate to full n_elem: [f0..f{k-1}, f0..f{k-1}] — litGPT repeats the
+    # half table so cos/sin have shape (S, n_elem)
+    idx_theta = np.concatenate([idx_theta, idx_theta], axis=-1)
+    return np.cos(idx_theta).astype(dtype), np.sin(idx_theta).astype(dtype)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate the leading `n_elem` channels of each head.
+
+    x: (..., T, head_size_slice) where the last dim == cos.shape[-1] == n_elem.
+    cos/sin: broadcastable to x, typically (T, n_elem) or (B, 1, T, n_elem).
+
+    Matches reference `apply_rope` (model.py:881-891): split in two halves,
+    rotated = concat(-x2, x1).
+    """
+    n = x.shape[-1]
+    x1 = x[..., : n // 2]
+    x2 = x[..., n // 2 :]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos + rotated * sin).astype(x.dtype)
+
+
+def gather_rope(
+    cos: jnp.ndarray, sin: jnp.ndarray, input_pos: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Index the rope cache at dynamic positions.
+
+    input_pos: int array (T,) or (B, T) → returns cos/sin of shape
+    input_pos.shape + (n_elem,), ready to broadcast over heads after adding
+    a head axis.
+    """
+    return jnp.take(cos, input_pos, axis=0), jnp.take(sin, input_pos, axis=0)

@@ -1,0 +1,80 @@
+"""Token sampling under jit: temperature, top-k, top-p.
+
+Capability parity with the reference sampling helpers
+(`/root/reference/src/sub/model.py:42-90`: `sample_top_p`, `sample`), built
+on `jax.random` so the whole decode step stays on-device.  Greedy decoding
+(temperature == 0) is exact argmax — the parity mode used by the
+golden-token tests (SURVEY.md §7 "output parity").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def logits_to_probs(
+    logits: jnp.ndarray,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+) -> jnp.ndarray:
+    """Softmax with temperature and optional top-k clamp (matches the
+    reference's order: scale, top-k filter, softmax — model.py:77-90)."""
+    logits = logits.astype(jnp.float32)
+    if temperature > 0:
+        logits = logits / temperature
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def sample_top_p(
+    logits: jnp.ndarray, key: jax.Array, top_p: float, temperature: float = 1.0
+) -> jnp.ndarray:
+    """Nucleus sampling (reference `sample_top_p`, model.py:42-58).
+
+    Keeps the smallest set of tokens whose cumulative probability exceeds
+    `top_p` (always including the most probable token), renormalizes, samples.
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature > 0:
+        logits = logits / temperature
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # mask tokens whose prefix-sum (exclusive) already exceeded top_p
+    exceeded = (cum - sorted_probs) > top_p
+    sorted_logits = jnp.where(exceeded, -jnp.inf, sorted_logits)
+    # map the threshold back to the unsorted logits: keep logits >= cutoff
+    cutoff = jnp.min(
+        jnp.where(exceeded, jnp.inf, sorted_logits), axis=-1, keepdims=True
+    )
+    filtered = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, filtered, axis=-1)
+
+
+def sample(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sample next token ids from logits (..., vocab).
+
+    temperature == 0 → greedy argmax (deterministic parity mode).
+    Mirrors reference `sample` (model.py:61-74) dispatch order: top-p wins if
+    set, else temperature+top-k, else greedy.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        return sample_top_p(logits, key, top_p, temperature)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)

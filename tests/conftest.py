@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+imports, so mesh/pipeline tests run anywhere (SURVEY.md §4 note: the
+reference's localhost-loopback trick maps to
+--xla_force_host_platform_device_count here)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platform_name", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
