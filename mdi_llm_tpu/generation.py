@@ -1,0 +1,307 @@
+"""Autoregressive generation engine: jitted prefill + decode, host loop.
+
+TPU-native equivalent of the reference generation paths —
+`GPT.generate`/`generate_chat` (`/root/reference/src/sub/model.py:460-573`)
+and the single-device driver (`/root/reference/src/sample.py:131-214`):
+
+- **Two jitted phases** (SURVEY.md §7 "shape polymorphism"): prefill pads the
+  prompt to a power-of-two bucket (one compile per bucket) and gathers the
+  last-valid-position logit per sample; decode is a fixed (B, 1) step.
+  Sampling runs inside jit so only the token ids cross the host boundary.
+- **Donated KV cache**: the cache argument is donated to the decode step, so
+  XLA updates it in place in HBM (≡ `KVCache.index_copy_`).
+- **Batched samples**: the reference round-robins ≥N samples over N pipeline
+  nodes to keep them busy ("recurrent pipeline parallelism"); on one chip the
+  analog is a batch axis over samples with per-sample positions — same
+  per-sample KV-cache semantics (gptserver.py:751-784) without Python-object
+  swapping.
+- **Stop tokens** are detected host-side per emitted token against the
+  style's stop sequences (≡ `detect_stop_tokens`, utils.py:185-225), and
+  `find_eot` truncation happens at decode end.
+- **Per-token timing** (`tok_time`) matches the reference's benchmark capture
+  (`gptserver.py:904-956`): list of (token_index, elapsed_seconds).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.ops.sampling import sample
+
+
+# ---------------------------------------------------------------------------
+# Stop-token utilities (host-side)
+# ---------------------------------------------------------------------------
+
+
+def detect_stop_tokens(tokens: Sequence[int], stop_sequences: Sequence[Sequence[int]]) -> bool:
+    """True if `tokens` ends with any of the stop sequences."""
+    for seq in stop_sequences:
+        n = len(seq)
+        if n and len(tokens) >= n and list(tokens[-n:]) == list(seq):
+            return True
+    return False
+
+
+def find_eot(tokens: Sequence[int], stop_sequences: Sequence[Sequence[int]]) -> int:
+    """Index of the first stop-sequence start in `tokens` (len(tokens) if
+    none) — truncation point for decoding (≡ reference `find_eot`)."""
+    tokens = list(tokens)
+    best = len(tokens)
+    for seq in stop_sequences:
+        n = len(seq)
+        if not n:
+            continue
+        for i in range(len(tokens) - n + 1):
+            if tokens[i : i + n] == list(seq):
+                best = min(best, i)
+                break
+    return best
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GenerationStats:
+    tok_time: List[Tuple[int, float]] = field(default_factory=list)
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    tokens_generated: int = 0
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.tokens_generated / self.decode_s if self.decode_s else 0.0
+
+
+class Generator:
+    """Compile-once, call-many generation driver for a single device (or a
+    data-parallel sharded batch; pipeline generation lives in
+    `mdi_llm_tpu.parallel.pipeline`)."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        max_seq_length: Optional[int] = None,
+        cache_dtype=jnp.bfloat16,
+        rng_seed: int = 1337,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
+        self.cache_dtype = cache_dtype
+        self.rope = transformer.get_rope_cache(cfg)
+        self.key = jax.random.PRNGKey(rng_seed)
+        self._prefill_fns: Dict[Tuple[int, int], Any] = {}
+        self._decode_fns: Dict[int, Any] = {}
+
+    # -- compiled phases -----------------------------------------------------
+
+    def _prefill_fn(self, B: int, T: int):
+        if (B, T) not in self._prefill_fns:
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def prefill(params, tokens, kv, true_len):
+                logits, kv = transformer.forward(
+                    self.cfg,
+                    params,
+                    tokens,
+                    jnp.zeros((tokens.shape[0],), jnp.int32),
+                    kv=kv,
+                    rope=self.rope,
+                )
+                last = jnp.take_along_axis(
+                    logits, (true_len - 1)[:, None, None], axis=1
+                )[:, 0]
+                return last, kv
+
+            self._prefill_fns[(B, T)] = prefill
+        return self._prefill_fns[(B, T)]
+
+    def _decode_fn(self, B: int):
+        if B not in self._decode_fns:
+
+            @partial(jax.jit, donate_argnums=(2,), static_argnames=("temperature", "top_k", "top_p"))
+            def decode(params, tokens, kv, input_pos, key, temperature, top_k, top_p):
+                logits, kv = transformer.forward(
+                    self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope
+                )
+                key, sub = jax.random.split(key)
+                tok = sample(
+                    logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p
+                )
+                return tok.astype(jnp.int32), kv, key
+
+            self._decode_fns[B] = decode
+        return self._decode_fns[B]
+
+    # -- public API ----------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+        stream_cb=None,
+    ) -> Tuple[List[List[int]], GenerationStats]:
+        """Generate continuations for a batch of token-id prompts.
+
+        Returns (full token lists incl. prompt, truncated at stop sequences)
+        and timing stats.  `stream_cb(sample_idx, token)` is invoked per
+        generated token when given (chat streaming).
+        """
+        B = len(prompts)
+        lens = [len(p) for p in prompts]
+        if min(lens) < 1:
+            raise ValueError("empty prompt")
+        max_len = max(lens)
+        total_max = max_len + max_new_tokens
+        if total_max > self.max_seq_length:
+            raise ValueError(
+                f"prompt+generation length {total_max} exceeds max_seq_length "
+                f"{self.max_seq_length}; pass --sequence-length or shorten"
+            )
+
+        Tb = _bucket(max_len)
+        batch = np.zeros((B, Tb), np.int32)
+        for i, p in enumerate(prompts):
+            batch[i, : lens[i]] = np.asarray(p, np.int32)
+
+        kv = transformer.init_kv_cache(
+            self.cfg, B, self.max_seq_length, dtype=self.cache_dtype
+        )
+
+        stats = GenerationStats()
+        t0 = time.perf_counter()
+        last_logits, kv = self._prefill_fn(B, Tb)(
+            self.params, jnp.asarray(batch), kv, jnp.asarray(lens, jnp.int32)
+        )
+        # first sampled token (from prefill logits)
+        self.key, sub = jax.random.split(self.key)
+        tok = sample(last_logits, sub, temperature=temperature, top_k=top_k, top_p=top_p)
+        tok = np.asarray(tok.astype(jnp.int32))
+        stats.prefill_s = time.perf_counter() - t0
+
+        decode = self._decode_fn(B)
+        out = [list(p) for p in prompts]
+        done = [False] * B
+        positions = np.asarray(lens, np.int32)
+        t_dec = time.perf_counter()
+
+        for step_i in range(max_new_tokens):
+            for b in range(B):
+                if not done[b]:
+                    out[b].append(int(tok[b]))
+                    if stream_cb is not None:
+                        stream_cb(b, int(tok[b]))
+                    if detect_stop_tokens(out[b][lens[b] :], stop_sequences):
+                        done[b] = True
+            stats.tok_time.append((step_i + 1, time.perf_counter() - t0))
+            if all(done) or step_i == max_new_tokens - 1:
+                break
+            if int(positions.max()) + 1 >= self.max_seq_length:
+                break
+            tok_j, kv, self.key = decode(
+                self.params,
+                jnp.asarray(tok, jnp.int32)[:, None],
+                kv,
+                jnp.asarray(positions),
+                self.key,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+            )
+            tok = np.asarray(tok_j)
+            positions = positions + 1
+
+        stats.decode_s = time.perf_counter() - t_dec
+        stats.tokens_generated = sum(len(o) - l for o, l in zip(out, lens))
+
+        # final truncation at the earliest stop sequence (≡ find_eot)
+        trimmed = []
+        for o, l in zip(out, lens):
+            gen = o[l:]
+            cut = find_eot(gen, stop_sequences)
+            trimmed.append(o[: l + cut])
+        return trimmed, stats
+
+    def generate_chat(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> Iterator[int]:
+        """Streaming single-sample generation (≡ `GPT.generate_chat`,
+        model.py:526-573): yields tokens as they are sampled, buffering
+        potential stop-sequence prefixes so a partial stop marker is never
+        emitted."""
+        max_stop = max((len(s) for s in stop_sequences), default=0)
+        pending: List[int] = []
+        for t in self._generate_stream(
+            prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
+        ):
+            pending.append(t)
+            if detect_stop_tokens(pending, stop_sequences):
+                return
+            # hold back max_stop-1 tokens that could begin a stop sequence
+            while len(pending) > max(0, max_stop - 1):
+                yield pending.pop(0)
+        yield from pending
+
+    def _generate_stream(self, prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences):
+        lens = len(prompt)
+        total_max = lens + max_new_tokens
+        if total_max > self.max_seq_length:
+            raise ValueError("prompt too long for max_seq_length")
+        Tb = _bucket(lens)
+        batch = np.zeros((1, Tb), np.int32)
+        batch[0, :lens] = np.asarray(prompt, np.int32)
+        kv = transformer.init_kv_cache(self.cfg, 1, self.max_seq_length, dtype=self.cache_dtype)
+        last_logits, kv = self._prefill_fn(1, Tb)(
+            self.params, jnp.asarray(batch), kv, jnp.asarray([lens], jnp.int32)
+        )
+        self.key, sub = jax.random.split(self.key)
+        tok = sample(last_logits, sub, temperature=temperature, top_k=top_k, top_p=top_p)
+        tok = np.asarray(tok.astype(jnp.int32))
+        decode = self._decode_fn(1)
+        pos = np.asarray([lens], np.int32)
+        history: List[int] = []
+        for i in range(max_new_tokens):
+            t = int(tok[0])
+            history.append(t)
+            yield t
+            if detect_stop_tokens(history, stop_sequences):
+                return
+            if i == max_new_tokens - 1 or int(pos[0]) + 1 >= self.max_seq_length:
+                return
+            tok_j, kv, self.key = decode(
+                self.params, jnp.asarray(tok)[:, None], kv, jnp.asarray(pos), self.key,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+            )
+            tok = np.asarray(tok_j)
+            pos = pos + 1

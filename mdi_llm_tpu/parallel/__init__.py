@@ -1,0 +1,2 @@
+"""Parallelism: stage partitioning, device meshes, pipeline runtime,
+sharding rules (tensor/data/sequence/expert)."""

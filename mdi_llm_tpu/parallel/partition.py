@@ -1,0 +1,136 @@
+"""Layer→stage partition policy.
+
+TPU-native generalization of the reference's static `N_LAYERS_NODES` table
+(`/root/reference/src/sub/config.py:56-98`, JSON twin `sub/split_map.json`):
+{n_nodes → {n_layer → starter/secondary layer counts}}, where the starter
+(stage 0) gets fewer layers because it also owns the embedding, final norm,
+LM head, and sampling.
+
+Here the table is a *policy function* for arbitrary (n_layer, n_stages),
+with the reference's hand-tuned entries preserved verbatim as overrides so
+existing deployments map 1:1.  Stage parameters are leading-axis slices of
+the stacked block pytree (`models.transformer.slice_blocks`) — no renaming
+or re-indexing (cf. reference `split_parameters`, utils.py:241-385).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.models.transformer import Params, slice_blocks
+
+# Reference-parity overrides: {n_stages: {n_layer: [stage0, stage1, ...]}}
+# computed from N_LAYERS_NODES's (start, secondary) pairs; the last stage
+# absorbs the remainder (reference gives all secondaries the same count and
+# relies on exact divisibility; entries below reproduce its counts exactly).
+_REFERENCE_TABLE: Dict[int, Dict[int, List[int]]] = {
+    1: {n: [n] for n in (5, 7, 9, 12, 22, 24, 32, 36, 48)},
+    2: {
+        5: [2, 3],
+        7: [3, 4],
+        9: [4, 5],
+        12: [5, 7],
+        22: [10, 12],
+        24: [10, 14],
+        32: [14, 18],
+        36: [16, 20],
+        48: [22, 26],
+    },
+    3: {
+        5: [1, 2, 2],
+        7: [1, 3, 3],
+        9: [1, 4, 4],
+        12: [2, 5, 5],
+        22: [6, 8, 8],
+        24: [4, 10, 10],
+        32: [8, 12, 12],
+        36: [10, 13, 13],
+        48: [14, 17, 17],
+    },
+    4: {22: [4, 6, 6, 6], 32: [5, 9, 9, 9]},
+    5: {22: [2, 5, 5, 5, 5], 32: [4, 7, 7, 7, 7]},
+}
+
+
+def stage_layers(
+    n_layer: int, n_stages: int, starter_fraction: float = 0.8
+) -> List[int]:
+    """Number of transformer blocks per pipeline stage.
+
+    Uses the reference's hand-tuned table when it has an entry; otherwise a
+    balanced split that discounts stage 0 by `starter_fraction` (stage 0
+    also runs embed/head/sampling).  Always sums to `n_layer`, every stage
+    gets ≥ 1 layer.
+    """
+    if n_stages < 1:
+        raise ValueError("n_stages must be >= 1")
+    if n_layer < n_stages:
+        raise ValueError(f"cannot split {n_layer} layers over {n_stages} stages")
+    ref = _REFERENCE_TABLE.get(n_stages, {}).get(n_layer)
+    if ref is not None:
+        return list(ref)
+    if n_stages == 1:
+        return [n_layer]
+    # weighted balanced split: stage 0 weight = starter_fraction, others 1.0
+    weights = [starter_fraction] + [1.0] * (n_stages - 1)
+    total_w = sum(weights)
+    counts = [max(1, int(n_layer * w / total_w)) for w in weights]
+    # distribute the remainder to the non-starter stages, last first
+    i = n_stages - 1
+    while sum(counts) < n_layer:
+        counts[i] += 1
+        i = n_stages - 1 if i <= 1 else i - 1
+    while sum(counts) > n_layer:
+        j = max(range(n_stages), key=lambda s: (counts[s], s))
+        counts[j] -= 1
+    return counts
+
+
+def stage_bounds(n_layer: int, n_stages: int, **kw) -> List[tuple]:
+    """[(start, stop) layer index per stage]."""
+    counts = stage_layers(n_layer, n_stages, **kw)
+    bounds = []
+    acc = 0
+    for c in counts:
+        bounds.append((acc, acc + c))
+        acc += c
+    return bounds
+
+
+def split_params(
+    cfg: Config, params: Params, n_stages: int, **kw
+) -> List[Params]:
+    """Carve a full model pytree into per-stage pytrees.
+
+    Stage 0: embeddings + its block slice + final norm + LM head (≡ reference
+    `StarterNode`, submodels.py:132-220); other stages: block slice only
+    (≡ `SecondaryNode`).  Pure slicing — weights stay in the stacked layout.
+    """
+    bounds = stage_bounds(cfg.n_layer, n_stages, **kw)
+    stages: List[Params] = []
+    for s, (lo, hi) in enumerate(bounds):
+        stage: Params = {"blocks": slice_blocks(params["blocks"], lo, hi)}
+        if s == 0:
+            for k in ("wte", "wpe", "ln_f", "lm_head"):
+                if k in params:
+                    stage[k] = params[k]
+        stages.append(stage)
+    return stages
+
+
+def save_stage_manifest(out_dir, cfg: Config, n_stages: int, **kw) -> Path:
+    """Write `stage_map.json` describing the partition (≡ split_map.json)."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    manifest = {
+        "n_stages": n_stages,
+        "n_layer": cfg.n_layer,
+        "stage_layers": stage_layers(cfg.n_layer, n_stages, **kw),
+        "model": cfg.name,
+    }
+    p = out_dir / "stage_map.json"
+    p.write_text(json.dumps(manifest, indent=2) + "\n")
+    return p

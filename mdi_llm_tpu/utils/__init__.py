@@ -1,0 +1,1 @@
+"""Host-side utilities: tokenization, prompts, checkpoints, data, plots."""

@@ -1,0 +1,183 @@
+"""Checkpoint pipeline tests.
+
+Golden parity: build tiny HF models with `transformers` (torch CPU), save
+them as real HF snapshots, convert with `convert_hf_checkpoint`, and require
+logit agreement between the JAX forward and the torch forward — this pins
+the QKV interleave, weight transposes, norm semantics, and RoPE convention
+against an independent public implementation (NOT the reference repo).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.models import forward, init_params
+from mdi_llm_tpu.parallel.partition import (
+    split_params,
+    stage_layers,
+    save_stage_manifest,
+)
+from mdi_llm_tpu.utils.checkpoint import (
+    convert_hf_checkpoint,
+    convert_to_hf_state_dict,
+    load_checkpoint,
+    save_checkpoint,
+)
+from tests.test_model import tiny_config
+
+
+def test_orbax_roundtrip(tmp_path):
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    save_checkpoint(params, cfg, tmp_path / "ckpt")
+    cfg2, params2 = load_checkpoint(tmp_path / "ckpt")
+    assert cfg2.n_layer == cfg.n_layer
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(params2)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("gqa", [False, True])
+def test_hf_llama_logit_parity(tmp_path, gqa):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2 if gqa else 4,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    assert cfg.n_query_groups == (2 if gqa else 4)
+
+    toks = np.array([[1, 5, 9, 44, 63, 2, 17]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_gpt2_logit_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(
+        vocab_size=96,
+        n_positions=64,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+    )
+    torch.manual_seed(1)
+    model = GPT2LMHeadModel(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    assert cfg.pos_embedding == "learned" and cfg.tie_embeddings
+
+    toks = np.array([[4, 7, 2, 90, 31]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_neox_logit_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=96,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=128,
+        rotary_pct=0.25,
+        max_position_embeddings=64,
+        use_parallel_residual=True,
+    )
+    torch.manual_seed(2)
+    model = GPTNeoXForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+
+    toks = np.array([[4, 7, 2, 90, 31, 8]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=3e-4, atol=3e-4)
+
+
+def test_reverse_conversion_roundtrip(tmp_path):
+    """convert_to_hf_state_dict must invert the fused layout exactly."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    sd = convert_to_hf_state_dict(cfg, params)
+    ref_sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    for k, v in sd.items():
+        np.testing.assert_array_equal(v, ref_sd[k], err_msg=k)
+
+
+# ---- partition policy ------------------------------------------------------
+
+
+def test_stage_layers_reference_parity():
+    """Hand-tuned reference table entries (config.py:56-98) survive."""
+    assert stage_layers(22, 3) == [6, 8, 8]
+    assert stage_layers(32, 3) == [8, 12, 12]
+    assert stage_layers(48, 2) == [22, 26]
+    assert stage_layers(12, 1) == [12]
+    assert stage_layers(22, 5) == [2, 5, 5, 5, 5]
+
+
+def test_stage_layers_generalizes():
+    for n_layer, n_stages in [(80, 8), (32, 6), (22, 7), (10, 10), (100, 3)]:
+        counts = stage_layers(n_layer, n_stages)
+        assert sum(counts) == n_layer
+        assert all(c >= 1 for c in counts)
+        assert counts[0] <= max(counts)  # starter never the heaviest
+
+
+def test_split_params_slices(tmp_path):
+    cfg = tiny_config(n_layer=5)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    stages = split_params(cfg, params, 3)
+    assert [s["blocks"]["norm_1"]["weight"].shape[0] for s in stages] == stage_layers(5, 3)
+    assert "wte" in stages[0] and "ln_f" in stages[0]
+    assert "wte" not in stages[1] and "ln_f" not in stages[2]
+    # stage blocks concatenated == original
+    cat = np.concatenate([np.asarray(s["blocks"]["attn"]["qkv"]["weight"]) for s in stages])
+    np.testing.assert_array_equal(cat, np.asarray(params["blocks"]["attn"]["qkv"]["weight"]))
+    p = save_stage_manifest(tmp_path, cfg, 3)
+    assert json.loads(p.read_text())["stage_layers"] == stage_layers(5, 3)

@@ -1,0 +1,106 @@
+"""Prompt styles, dispatch rules, persistence, FILE: loader; tokenizer
+round-trip with a generated tokenizer.json fixture."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.utils.prompts import (
+    PromptStyle,
+    get_user_prompt,
+    has_prompt_style,
+    load_prompt_style,
+    save_prompt_style,
+    style_for_model,
+    styles,
+)
+from mdi_llm_tpu.utils.tokenizer import Tokenizer
+
+
+def test_style_dispatch_rules():
+    assert style_for_model("Llama-3-8B-Instruct").name == "llama3"
+    assert style_for_model("Llama-2-7b-chat-hf").name == "llama2"
+    assert style_for_model("tiny-llama-1.1b-chat").name == "tinyllama"
+    assert style_for_model("Mistral-7B-Instruct-v0.2").name == "codellama"
+    assert style_for_model("falcon-7b-instruct").name == "falcon"
+    assert style_for_model("NanoLlama").name == "no-prompt"
+    assert style_for_model("gpt2-medium").name == "default"
+    assert style_for_model("Gemma-2b-it").name == "gemma"
+
+
+def test_templates_wrap_prompt():
+    for name, st in styles.items():
+        out = st.apply("HELLO_WORLD")
+        if name == "no-prompt":
+            assert out == "\n"
+        else:
+            assert "HELLO_WORLD" in out, name
+
+
+def test_llama3_template_markers():
+    out = styles["llama3"].apply("hi")
+    assert out.startswith("<|begin_of_text|>")
+    assert "<|start_header_id|>assistant<|end_header_id|>" in out
+
+
+def test_persistence(tmp_path):
+    save_prompt_style("llama3", tmp_path)
+    assert has_prompt_style(tmp_path)
+    assert load_prompt_style(tmp_path).name == "llama3"
+    with pytest.raises(ValueError):
+        save_prompt_style("nope", tmp_path)
+
+
+def test_get_user_prompt_file(tmp_path):
+    f = tmp_path / "prompts.txt"
+    f.write_text("first prompt\n\nsecond prompt\n\n\nthird prompt\n")
+    got = get_user_prompt(f"FILE:{f}", 2)
+    assert got == ["first prompt", "second prompt"]
+    got = get_user_prompt(f"FILE:{f}", 5)
+    assert got == ["first prompt", "second prompt", "third prompt", "first prompt", "second prompt"]
+    got = get_user_prompt("plain", 3)
+    assert got == ["plain"] * 3
+
+
+@pytest.fixture(scope="module")
+def hf_tok_dir(tmp_path_factory):
+    """Build a tiny word-level tokenizer.json + config files."""
+    from tokenizers import Tokenizer as HFTok
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    d = tmp_path_factory.mktemp("tok")
+    vocab = {"<s>": 0, "</s>": 1, "hello": 2, "world": 3, "the": 4, "cat": 5}
+    t = HFTok(WordLevel(vocab, unk_token="</s>"))
+    t.pre_tokenizer = Whitespace()
+    t.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(
+        json.dumps({"bos_token": "<s>", "eos_token": "</s>", "add_bos_token": True})
+    )
+    return d
+
+
+def test_tokenizer_roundtrip(hf_tok_dir):
+    tok = Tokenizer(hf_tok_dir)
+    assert tok.backend == "huggingface"
+    assert tok.bos_id == 0 and tok.eos_id == 1
+    ids = tok.encode("hello world the cat")
+    assert ids.dtype == np.int32
+    assert ids.tolist() == [0, 2, 3, 4, 5]  # bos prepended
+    assert tok.encode("hello", bos=False).tolist() == [2]
+    assert tok.encode("hello", bos=False, eos=True).tolist() == [2, 1]
+    assert tok.encode("hello world", bos=False, max_length=1).tolist() == [2]
+    assert "hello" in tok.decode(np.array([2, 3]))
+
+
+def test_tokenizer_stop_sequences(hf_tok_dir):
+    tok = Tokenizer(hf_tok_dir)
+    st = styles["default"]
+    seqs = st.stop_tokens(tok)
+    assert seqs == ([1],)
+
+
+def test_tokenizer_missing_dir():
+    with pytest.raises(NotADirectoryError):
+        Tokenizer("/nonexistent/path")
