@@ -1,0 +1,43 @@
+"""Device-mesh construction helpers.
+
+The reference's "network topology JSON" (`src/settings_distr/*.json`: node
+addresses, ports) maps on TPU to a `jax.sharding.Mesh` over the device grid:
+pipeline stages live on a 1-D `pipe` axis (ICI/DCN neighbors), and training
+uses `dp`/`tp`(/`sp`) axes.  Multi-host: `jax.distributed.initialize` makes
+all processes see the global device list, replacing the reference's HTTP
+`/init` bootstrap (`model_dist.py:402-497`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axes: Dict[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a Mesh with the given {axis_name: size}.  Sizes must multiply to
+    the device count used; pass -1 for one axis to infer it."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = dict(axes)
+    infer = [k for k, v in sizes.items() if v == -1]
+    if len(infer) > 1:
+        raise ValueError("only one axis size may be -1")
+    known = int(np.prod([v for v in sizes.values() if v != -1]))
+    if infer:
+        if len(devices) % known:
+            raise ValueError(f"{len(devices)} devices not divisible by {known}")
+        sizes[infer[0]] = len(devices) // known
+    total = int(np.prod(list(sizes.values())))
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+    grid = np.asarray(devices[:total]).reshape(*sizes.values())
+    return Mesh(grid, tuple(sizes.keys()))
+
+
+def pipeline_mesh(n_stages: int, devices: Optional[Sequence] = None) -> Mesh:
+    return make_mesh({"pipe": n_stages}, devices)

@@ -1,0 +1,503 @@
+"""Recurrent pipeline-parallel generation over a 1-D device mesh.
+
+TPU-native re-design of the reference's distributed inference runtime
+(`/root/reference/src/sub/gptserver.py` `_starter_loop`/`_secondary_loop`,
+`connections.py` socket ring, `model_dist.py` orchestration):
+
+- The "network of nodes" is a 1-D `pipe` mesh axis; stage s holds its slice
+  of transformer blocks (zero-padded to the per-stage max so SPMD stays
+  uniform — zero-weight blocks are exact identities thanks to the residual
+  structure).
+- The TCP/pickle activation hop (`connections.py:325-342`) becomes a single
+  `jax.lax.ppermute` inside a jitted step: one (1, n_embd) activation per
+  stage boundary per micro-step, the same wire economy the reference gets
+  from its rotating KV caches (README.md:239-246).
+- "Recurrent pipeline parallelism" (`model_dist.py:56-71`): with S stages
+  and a ring of S in-flight samples, every micro-step advances one sample
+  per stage; a full rotation (S micro-steps, scanned inside one jit call)
+  yields one new token for every in-flight sample.  Samples beyond S run in
+  waves over the same cache slots.
+- Stage 0 plays the reference starter (submodels.py:132-220): on each
+  micro-step it applies final-norm + LM head + sampling to the activation
+  returning from the last stage, embeds the sampled token, and feeds it back
+  into the ring.  Other stages run blocks only (≡ SecondaryNode).
+- The reference's HTTP control plane + host queues collapse into a tiny
+  host-side "override" channel: per micro-step the host may replace the
+  payload entering stage 0 (used to seed a wave's first tokens after
+  prefill; the mechanism also supports mid-flight sample swap).
+- Per-sample rotating KV caches (`gptserver.py:751-784`): each stage keeps a
+  cache slot per in-flight sample `(L_stage, n_slots, G, seq, hs)`; the slot
+  id travels with the activation.  A trailing dummy slot absorbs writes from
+  bubble (invalid) payloads.
+
+Correctness is pinned by golden-token tests: pipeline generation must equal
+single-device greedy generation token-for-token (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
+from mdi_llm_tpu.generation import (
+    GenerationStats,
+    _bucket,
+    detect_stop_tokens,
+    find_eot,
+)
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.ops.sampling import sample
+from mdi_llm_tpu.parallel.mesh import pipeline_mesh
+from mdi_llm_tpu.parallel.partition import split_params, stage_layers
+
+
+def _pad_stage_blocks(stages: List[Any], l_max: int):
+    """Zero-pad every stage's block stack to `l_max` layers and stack into
+    per-leaf arrays with a leading stage axis (S, l_max, ...).  Zero-weight
+    blocks are exact identities (residual adds zero), so no layer mask is
+    needed."""
+
+    def pad(leaf):
+        leaf = np.asarray(leaf)
+        pad_width = [(0, l_max - leaf.shape[0])] + [(0, 0)] * (leaf.ndim - 1)
+        return np.pad(leaf, pad_width)
+
+    padded = [jax.tree_util.tree_map(pad, s["blocks"]) for s in stages]
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *padded)
+
+
+class PipelineEngine:
+    """Compile-once pipeline generation driver.
+
+    `params` is a full-model pytree (stacked layers); it is partitioned with
+    the same policy table as the reference (`partition.stage_layers`) and
+    laid out over `mesh` ("pipe" axis).
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        n_stages: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        max_seq_length: Optional[int] = None,
+        cache_dtype=jnp.bfloat16,
+        rng_seed: int = 1337,
+        devices: Optional[Sequence] = None,
+    ):
+        if mesh is None:
+            mesh = pipeline_mesh(n_stages or len(devices or jax.devices()), devices)
+        self.mesh = mesh
+        S = int(mesh.devices.size)
+        self.n_stages = S
+        self.cfg = cfg
+        self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
+        self.cache_dtype = cache_dtype
+        self.key = jax.random.PRNGKey(rng_seed)
+
+        counts = stage_layers(cfg.n_layer, S)
+        self.l_max = max(counts)
+        stages = split_params(cfg, params, S)
+
+        pipe_sh = NamedSharding(mesh, P("pipe"))
+        repl_sh = NamedSharding(mesh, P())
+        blocks_np = _pad_stage_blocks(stages, self.l_max)
+        self.stage_blocks = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, pipe_sh), blocks_np
+        )
+        # embedding / final norm / head replicated on every stage (vocab
+        # sharding over the pipe axis is the planned optimization)
+        head_params = {
+            k: stages[0][k] for k in ("wte", "wpe", "ln_f", "lm_head") if k in stages[0]
+        }
+        self.head_params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), repl_sh), head_params
+        )
+        rope = transformer.get_rope_cache(cfg)
+        self.rope = tuple(jax.device_put(np.asarray(r), repl_sh) for r in rope)
+
+        self.n_slots = S + 1  # one cache slot per ring position + dummy
+        self._prefill_jit: Dict[Tuple, Any] = {}
+        self._decode_jit: Dict[Tuple, Any] = {}
+
+    # ------------------------------------------------------------------
+    # state builders
+    # ------------------------------------------------------------------
+
+    def _init_kv(self):
+        shape = (
+            self.n_stages,
+            self.l_max,
+            self.n_slots,
+            self.cfg.n_query_groups,
+            self.max_seq_length,
+            self.cfg.head_size,
+        )
+        sh = NamedSharding(self.mesh, P("pipe"))
+        return {
+            "k": jax.device_put(jnp.zeros(shape, self.cache_dtype), sh),
+            "v": jax.device_put(jnp.zeros(shape, self.cache_dtype), sh),
+        }
+
+    def _init_payload(self, T: int, dtype):
+        sh = NamedSharding(self.mesh, P("pipe"))
+        S = self.n_stages
+        return {
+            "x": jax.device_put(jnp.zeros((S, T, self.cfg.n_embd), dtype), sh),
+            "sid": jax.device_put(jnp.full((S, 1), self.n_slots - 1, jnp.int32), sh),
+            "pos": jax.device_put(jnp.zeros((S, 1), jnp.int32), sh),
+            "valid": jax.device_put(jnp.zeros((S, 1), jnp.int32), sh),
+        }
+
+    # ------------------------------------------------------------------
+    # per-stage block execution (local view inside shard_map)
+    # ------------------------------------------------------------------
+
+    def _run_stage_blocks(self, blocks, rope, kv_k, kv_v, x, sid, input_pos):
+        """Run the local (padded) block stack on x (T, D) using cache slot
+        `sid` at offset `input_pos` (scalars); returns (x_out, kv_k, kv_v)."""
+        cfg = self.cfg
+        T = x.shape[0]
+        xb = x[None]  # (1, T, D)
+        ip = input_pos.reshape(1)
+        pos = ip[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        cos = jnp.take(rope[0], pos, axis=0)
+        sin = jnp.take(rope[1], pos, axis=0)
+        k_slot = jax.lax.dynamic_slice_in_dim(kv_k, sid, 1, axis=1)
+        v_slot = jax.lax.dynamic_slice_in_dim(kv_v, sid, 1, axis=1)
+        x_out, kv_new = transformer.run_blocks(
+            cfg, blocks, xb, pos, cos, sin, {"k": k_slot, "v": v_slot}, ip
+        )
+        kv_k = jax.lax.dynamic_update_slice_in_dim(kv_k, kv_new["k"], sid, axis=1)
+        kv_v = jax.lax.dynamic_update_slice_in_dim(kv_v, kv_new["v"], sid, axis=1)
+        return x_out[0], kv_k, kv_v
+
+    # ------------------------------------------------------------------
+    # jitted phases
+    # ------------------------------------------------------------------
+
+    def _get_prefill(self, W: int, T: int, temperature, top_k, top_p):
+        key = (W, T, temperature, top_k, top_p)
+        if key not in self._prefill_jit:
+            self._prefill_jit[key] = self._build_prefill(W, T, temperature, top_k, top_p)
+        return self._prefill_jit[key]
+
+    def _get_decode(self, temperature, top_k, top_p):
+        key = (temperature, top_k, top_p)
+        if key not in self._decode_jit:
+            self._decode_jit[key] = self._build_decode(temperature, top_k, top_p)
+        return self._decode_jit[key]
+
+    def _build_prefill(self, W: int, T: int, temperature, top_k, top_p):
+        cfg, S, mesh = self.cfg, self.n_stages, self.mesh
+        n_steps = W + S
+        dummy = self.n_slots - 1
+
+        def ring(blocks, head, rope, kv, payload, prompts, lens, key):
+            stage = jax.lax.axis_index("pipe")
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            # strip the local stage axis (size 1) from the sharded operands
+            blocks = jax.tree_util.tree_map(lambda a: a[0], blocks)
+
+            def body(carry, step):
+                kv_k, kv_v, x, sid, pos, valid, key = carry
+                sid0, pos0, val0 = sid[0], pos[0], valid[0]
+
+                # ---- stage 0: head + first-token sample on the returning
+                # activation (gather the last valid position) ----
+                x_ret = jax.lax.dynamic_slice_in_dim(
+                    x, jnp.maximum(pos0 - 1, 0), 1, axis=0
+                )  # (1, D)
+                logits = transformer.head(cfg, head, x_ret[None])[0, 0]  # (V,)
+                key, sub = jax.random.split(key)
+                tok = sample(
+                    logits[None], sub, temperature=temperature, top_k=top_k, top_p=top_p
+                )[0].astype(jnp.int32)
+                emit = (tok.reshape(1), sid0.reshape(1), val0.reshape(1))
+
+                # ---- stage 0: inject prompt `step` into the ring ----
+                inj_valid = (step < W).astype(jnp.int32)
+                inj_idx = jnp.minimum(step, W - 1)
+                inj_tokens = jax.lax.dynamic_slice_in_dim(prompts, inj_idx, 1, axis=0)
+                pos_grid = jnp.arange(T, dtype=jnp.int32)[None, :]
+                emb = transformer.embed(cfg, head, inj_tokens, pos_grid)[0]
+
+                is0 = stage == 0
+                x_proc = jnp.where(is0, emb.astype(x.dtype), x)
+                sid_proc = jnp.where(
+                    is0, jnp.where(inj_valid == 1, inj_idx, dummy), sid0
+                )
+                len_proc = jnp.where(
+                    is0, jax.lax.dynamic_slice_in_dim(lens, inj_idx, 1)[0], pos0
+                )
+                val_proc = jnp.where(is0, inj_valid, val0)
+
+                x_out, kv_k, kv_v = self._run_stage_blocks(
+                    blocks, rope, kv_k, kv_v, x_proc, sid_proc, jnp.int32(0)
+                )
+                x_n = jax.lax.ppermute(x_out, "pipe", perm)
+                sid_n = jax.lax.ppermute(sid_proc.reshape(1), "pipe", perm)
+                pos_n = jax.lax.ppermute(len_proc.reshape(1), "pipe", perm)
+                val_n = jax.lax.ppermute(val_proc.reshape(1), "pipe", perm)
+                return (kv_k, kv_v, x_n, sid_n, pos_n, val_n, key), emit
+
+            carry = (
+                kv["k"][0],
+                kv["v"][0],
+                payload["x"][0],
+                payload["sid"][0],
+                payload["pos"][0],
+                payload["valid"][0],
+                key,
+            )
+            carry, emits = jax.lax.scan(
+                body, carry, jnp.arange(n_steps, dtype=jnp.int32)
+            )
+            kv_out = {"k": carry[0][None], "v": carry[1][None]}
+            return kv_out, emits
+
+        pipe, repl = P("pipe"), P()
+        sm = jax.shard_map(
+            ring,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: pipe, self.stage_blocks),
+                jax.tree_util.tree_map(lambda _: repl, self.head_params),
+                (repl, repl),
+                {"k": pipe, "v": pipe},
+                {"x": pipe, "sid": pipe, "pos": pipe, "valid": pipe},
+                repl,
+                repl,
+                repl,
+            ),
+            out_specs=(
+                {"k": pipe, "v": pipe},
+                (P(None, "pipe"), P(None, "pipe"), P(None, "pipe")),
+            ),
+        )
+        return jax.jit(sm, donate_argnums=(3, 4))
+
+    def _build_decode(self, temperature, top_k, top_p):
+        cfg, S, mesh = self.cfg, self.n_stages, self.mesh
+
+        def ring(blocks, head, rope, kv, payload, overrides, key):
+            stage = jax.lax.axis_index("pipe")
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            blocks = jax.tree_util.tree_map(lambda a: a[0], blocks)
+
+            def body(carry, step_in):
+                kv_k, kv_v, x, sid, pos, valid, key = carry
+                ov_flag, ov_sid, ov_tok, ov_pos = step_in
+                sid0, pos0, val0 = sid[0], pos[0], valid[0]
+
+                # stage 0: head + sample on the returning activation (T=1)
+                logits = transformer.head(cfg, head, x[None])[0, -1]  # (V,)
+                key, sub = jax.random.split(key)
+                tok = sample(
+                    logits[None], sub, temperature=temperature, top_k=top_k, top_p=top_p
+                )[0].astype(jnp.int32)
+                emit = (tok.reshape(1), sid0.reshape(1), val0.reshape(1))
+
+                use_ov = ov_flag == 1
+                tok_sel = jnp.where(use_ov, ov_tok, tok)
+                sid_sel = jnp.where(use_ov, ov_sid, sid0)
+                pos_sel = jnp.where(use_ov, ov_pos, pos0 + 1)
+                val_sel = jnp.where(use_ov, jnp.int32(1), val0)
+
+                emb = transformer.embed(
+                    cfg, head, tok_sel.reshape(1, 1), pos_sel.reshape(1, 1)
+                )[0]  # (1, D)
+
+                is0 = stage == 0
+                x_proc = jnp.where(is0, emb.astype(x.dtype), x)
+                sid_proc = jnp.where(is0, sid_sel, sid0)
+                pos_proc = jnp.where(is0, pos_sel, pos0)
+                val_proc = jnp.where(is0, val_sel, val0)
+
+                x_out, kv_k, kv_v = self._run_stage_blocks(
+                    blocks, rope, kv_k, kv_v, x_proc, sid_proc, pos_proc
+                )
+                x_n = jax.lax.ppermute(x_out, "pipe", perm)
+                sid_n = jax.lax.ppermute(sid_proc.reshape(1), "pipe", perm)
+                pos_n = jax.lax.ppermute(pos_proc.reshape(1), "pipe", perm)
+                val_n = jax.lax.ppermute(val_proc.reshape(1), "pipe", perm)
+                return (kv_k, kv_v, x_n, sid_n, pos_n, val_n, key), emit
+
+            carry = (
+                kv["k"][0],
+                kv["v"][0],
+                payload["x"][0],
+                payload["sid"][0],
+                payload["pos"][0],
+                payload["valid"][0],
+                key,
+            )
+            carry, emits = jax.lax.scan(body, carry, overrides)
+            kv_out = {"k": carry[0][None], "v": carry[1][None]}
+            payload_out = {
+                "x": carry[2][None],
+                "sid": carry[3][None],
+                "pos": carry[4][None],
+                "valid": carry[5][None],
+            }
+            return kv_out, payload_out, emits
+
+        pipe, repl = P("pipe"), P()
+        sm = jax.shard_map(
+            ring,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: pipe, self.stage_blocks),
+                jax.tree_util.tree_map(lambda _: repl, self.head_params),
+                (repl, repl),
+                {"k": pipe, "v": pipe},
+                {"x": pipe, "sid": pipe, "pos": pipe, "valid": pipe},
+                repl,
+                repl,
+            ),
+            out_specs=(
+                {"k": pipe, "v": pipe},
+                {"x": pipe, "sid": pipe, "pos": pipe, "valid": pipe},
+                (P(None, "pipe"), P(None, "pipe"), P(None, "pipe")),
+            ),
+        )
+        return jax.jit(sm, donate_argnums=(3, 4))
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> Tuple[List[List[int]], GenerationStats]:
+        """Generate continuations for n_samples prompts using recurrent
+        pipeline parallelism.  Samples are processed in waves of up to
+        n_stages (the reference requires n_samples ≥ n_nodes for full
+        utilization, README.md:33-37; same economics here)."""
+        S = self.n_stages
+        stats = GenerationStats()
+        results: List[List[int]] = [[] for _ in prompts]
+        t_all = time.perf_counter()
+        for wave_start in range(0, len(prompts), S):
+            wave = list(prompts[wave_start : wave_start + S])
+            outs = self._generate_wave(
+                wave, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
+            )
+            for i, o in enumerate(outs):
+                results[wave_start + i] = o
+        stats.decode_s = time.perf_counter() - t_all - stats.prefill_s
+        stats.tokens_generated = sum(
+            len(o) - len(p) for o, p in zip(results, prompts)
+        )
+        return results, stats
+
+    def _generate_wave(
+        self, prompts, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
+    ):
+        S = self.n_stages
+        W = len(prompts)
+        lens = [len(p) for p in prompts]
+        if min(lens) < 1:
+            raise ValueError("empty prompt")
+        if max(lens) + max_new_tokens > self.max_seq_length:
+            raise ValueError(
+                f"prompt+generation length {max(lens) + max_new_tokens} exceeds "
+                f"max_seq_length {self.max_seq_length}"
+            )
+        Tb = _bucket(max(lens))
+
+        prompts_np = np.zeros((W, Tb), np.int32)
+        for i, p in enumerate(prompts):
+            prompts_np[i, : lens[i]] = np.asarray(p, np.int32)
+
+        kv = self._init_kv()
+        dtype = jax.tree_util.tree_leaves(self.stage_blocks)[0].dtype
+
+        # ---- phase 1: pipelined prefill ----
+        t_p = time.perf_counter()
+        prefill = self._get_prefill(W, Tb, temperature, top_k, top_p)
+        payload = self._init_payload(Tb, dtype)
+        self.key, sub = jax.random.split(self.key)
+        kv, emits = prefill(
+            self.stage_blocks,
+            self.head_params,
+            self.rope,
+            kv,
+            payload,
+            jnp.asarray(prompts_np),
+            jnp.asarray(lens, jnp.int32),
+            sub,
+        )
+        toks_e, sids_e, vals_e = (np.asarray(e)[:, 0] for e in emits)
+        first_tok = {
+            int(s): int(t) for t, s, v in zip(toks_e, sids_e, vals_e) if v and s < W
+        }
+        assert len(first_tok) == W, f"prefill returned {len(first_tok)}/{W} samples"
+        stats.prefill_s += time.perf_counter() - t_p
+
+        out = [list(p) for p in prompts]
+        done = [False] * W
+        for j in range(W):
+            out[j].append(first_tok[j])
+            if detect_stop_tokens(out[j][lens[j] :], stop_sequences):
+                done[j] = True
+        n_tok = 1
+
+        # ---- phase 2: decode rotations ----
+        decode = self._get_decode(temperature, top_k, top_p)
+        payload = self._init_payload(1, dtype)
+
+        # seeding rotation: inject sample j's first token at micro-step j
+        ov = np.zeros((S, 4), np.int32)
+        for j in range(W):
+            ov[j] = (1, j, first_tok[j], lens[j])
+        seeded = False
+        while n_tok < max_new_tokens and not all(done):
+            if max(lens) + n_tok + 1 > self.max_seq_length:
+                break
+            self.key, sub = jax.random.split(self.key)
+            kv, payload, emits = decode(
+                self.stage_blocks,
+                self.head_params,
+                self.rope,
+                kv,
+                payload,
+                jnp.asarray(ov),
+                sub,
+            )
+            if not seeded:
+                # the seeding rotation emits only bubble payloads
+                ov = np.zeros((S, 4), np.int32)
+                seeded = True
+                continue
+            toks_e, sids_e, vals_e = (np.asarray(e)[:, 0] for e in emits)
+            for t, s, v in zip(toks_e, sids_e, vals_e):
+                s = int(s)
+                if v and s < W and not done[s]:
+                    out[s].append(int(t))
+                    if detect_stop_tokens(out[s][lens[s] :], stop_sequences):
+                        done[s] = True
+            n_tok += 1
+            stats.tok_time.append(
+                (sum(len(o) - l for o, l in zip(out, lens)), time.perf_counter() - t_all)
+            )
+
+        trimmed = []
+        for o, l in zip(out, lens):
+            gen = o[l:]
+            cut = find_eot(gen, stop_sequences)
+            trimmed.append(o[: l + cut])
+        return trimmed

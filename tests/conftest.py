@@ -1,11 +1,15 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-imports, so mesh/pipeline tests run anywhere (SURVEY.md §4 note: the
+"""Test configuration: force an 8-device virtual CPU platform BEFORE any jax
+computation, so mesh/pipeline tests run anywhere (SURVEY.md §4 note: the
 reference's localhost-loopback trick maps to
---xla_force_host_platform_device_count here)."""
+--xla_force_host_platform_device_count here).
+
+Note: this image boots an `axon` TPU backend via sitecustomize and pins
+JAX_PLATFORMS=axon, so the env-var route is overridden; updating the
+`jax_platforms` config before first backend use is what actually works.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,11 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platform_name", "cpu")
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def devices():
+    assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8
     return jax.devices()
