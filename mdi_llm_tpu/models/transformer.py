@@ -223,8 +223,12 @@ def run_blocks(
     sin: jnp.ndarray,
     kv: Optional[KVCache] = None,  # k/v: (L_stage, B, G, S, hs)
     input_pos: Optional[jnp.ndarray] = None,  # (B,)
+    remat: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
-    """Scan the block stack. One compiled block, L iterations."""
+    """Scan the block stack. One compiled block, L iterations.  `remat=True`
+    rematerializes each block under autodiff (training memory ∝ 1 layer's
+    activations instead of L — the TPU substitute for the reference's AMP
+    memory savings, SURVEY.md §2.4)."""
 
     if kv is None:
 
@@ -234,6 +238,8 @@ def run_blocks(
             )
             return y, None
 
+        if remat:
+            body = jax.checkpoint(body)
         x, _ = jax.lax.scan(body, x, blocks)
         return x, None
 
@@ -281,6 +287,7 @@ def forward(
     input_pos: jnp.ndarray,  # (B,) start offset of this chunk
     kv: Optional[KVCache] = None,
     rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    remat: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
 
@@ -295,7 +302,9 @@ def forward(
     cos = jnp.take(rope[0], pos, axis=0)
     sin = jnp.take(rope[1], pos, axis=0)
     x = embed(cfg, params, tokens, pos)
-    x, kv = run_blocks(cfg, params["blocks"], x, pos, cos, sin, kv, input_pos)
+    x, kv = run_blocks(
+        cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat
+    )
     return head(cfg, params, x), kv
 
 

@@ -1,0 +1,110 @@
+"""GSPMD sharding rules for training and batched inference.
+
+The reference's only training parallelism is torch DDP over NCCL
+(`/root/reference/src/train.py:88-103,250-251`).  Here parallelism is
+declarative: a mesh with `dp` (data), `tp` (tensor), and optionally `ep`
+(expert) axes plus PartitionSpecs per parameter leaf; XLA inserts the
+collectives (psum for DP grads ≡ DDP all-reduce, all-gather/reduce-scatter
+for TP) over ICI.
+
+Rules (Megatron-style, laid over the stacked-layer pytree):
+- qkv / fc up-projections: shard output features on `tp` (column parallel)
+- attn proj / mlp down-projection: shard input features on `tp` (row parallel)
+- embeddings + lm_head: shard vocab on `tp`
+- MoE experts: shard the expert axis on `ep` (defaults to the `tp` axis)
+- norms, biases of row-parallel layers: replicated
+- batch: shard on `dp`; sequence axis optionally on `sp` (ring attention)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from mdi_llm_tpu.config import Config
+
+
+def param_specs(
+    cfg: Config, tp_axis: Optional[str] = "tp", ep_axis: Optional[str] = None
+) -> Dict[str, Any]:
+    """PartitionSpec pytree matching the params pytree layout.
+
+    Every block leaf has a leading layer axis (never sharded).  Pass
+    tp_axis=None for pure data parallelism (fully replicated params).
+    """
+    t = tp_axis
+    e = ep_axis or tp_axis
+
+    def lin_col(bias: bool):  # output features sharded
+        d = {"weight": P(None, t, None)}
+        if bias:
+            d["bias"] = P(None, t)
+        return d
+
+    def lin_row(bias: bool):  # input features sharded
+        d = {"weight": P(None, None, t)}
+        if bias:
+            d["bias"] = P(None, None)
+        return d
+
+    def norm():
+        d = {"weight": P(None, None)}
+        if cfg.norm_class_name == "LayerNorm" and cfg.bias:
+            d["bias"] = P(None, None)
+        return d
+
+    attn = {"qkv": lin_col(cfg.bias), "proj": lin_row(cfg.bias)}
+    if cfg.mlp_class_name == "GptNeoxMLP":
+        mlp = {"fc": lin_col(cfg.bias), "proj": lin_row(cfg.bias)}
+    elif cfg.mlp_class_name in ("LLaMAMLP", "GemmaMLP"):
+        mlp = {
+            "fc_1": {"weight": P(None, t, None)},
+            "fc_2": {"weight": P(None, t, None)},
+            "proj": {"weight": P(None, None, t)},
+        }
+    else:  # LLaMAMoE: shard experts over ep
+        mlp = {
+            "gate": {"weight": P(None, None, None)},
+            "experts": {
+                "fc_1": {"weight": P(None, e, None, None)},
+                "fc_2": {"weight": P(None, e, None, None)},
+                "proj": {"weight": P(None, e, None, None)},
+            },
+        }
+    blocks = {"norm_1": norm(), "attn": attn, "mlp": mlp}
+    if not cfg.shared_attention_norm:
+        blocks["norm_2"] = norm()
+
+    specs: Dict[str, Any] = {
+        "wte": {"weight": P(t, None)},
+        "blocks": blocks,
+        "ln_f": {
+            "weight": P(None),
+            **({"bias": P(None)} if cfg.norm_class_name == "LayerNorm" and cfg.bias else {}),
+        },
+    }
+    if cfg.pos_embedding == "learned":
+        specs["wpe"] = {"weight": P(None, None)}
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = {"weight": P(t, None)}
+        if cfg.lm_head_bias:
+            specs["lm_head"]["bias"] = P(t)
+    elif cfg.lm_head_bias:
+        specs["lm_head"] = {"bias": P(t)}
+    return specs
+
+
+def shard_params(params: Any, cfg: Config, mesh: Mesh, tp_axis: Optional[str] = "tp"):
+    """Place a params pytree onto `mesh` under the TP rules."""
+    tp = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
+    specs = param_specs(cfg, tp)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def batch_spec(dp_axis: str = "dp", sp_axis: Optional[str] = None) -> P:
+    return P(dp_axis, sp_axis)
