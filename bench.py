@@ -1,0 +1,104 @@
+"""Benchmark harness: decode throughput on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Scenario (mirrors BASELINE.md's TinyLlama configuration): TinyLlama-1.1B
+architecture, bf16, random weights (numerics identical to converted weights
+for throughput purposes), batched recurrent decode of 8 samples — the
+single-chip analog of the reference's "3-node recurrent pipeline,
+n-samples≥3" runs.  `vs_baseline` compares against ~7 tokens/s aggregate,
+the 3×Jetson-TX2 TinyLlama rate read off the reference's published
+tokens-vs-time plot (assets/time_vs_tokens_TinyLlama.png; no numeric tables
+exist — BASELINE.md).
+
+Flags: --model/--batch/--prompt-len/--new-tokens/--pipeline N to bench the
+pipeline engine instead of batched single-chip decode.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_TOKENS_PER_S = 7.0  # 3×Jetson TX2, TinyLlama, from the plot
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny-llama-1.1b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=512)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--pipeline", type=int, default=0, help="run N-stage pipeline engine")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--chunk", type=int, default=128, help="decode steps per jit call")
+    args = ap.parse_args()
+
+    from mdi_llm_tpu.config import Config
+    from mdi_llm_tpu.models import transformer
+
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[args.dtype]
+    cfg = Config.from_name(args.model)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
+        for _ in range(args.batch)
+    ]
+
+    if args.pipeline:
+        from mdi_llm_tpu.parallel.pipeline import PipelineEngine
+
+        engine = PipelineEngine(
+            cfg,
+            params,
+            n_stages=args.pipeline,
+            max_seq_length=args.seq_len,
+            cache_dtype=dtype,
+        )
+        label = f"pipeline{args.pipeline}"
+    else:
+        from mdi_llm_tpu.generation import Generator
+
+        engine = Generator(
+            cfg, params, max_seq_length=args.seq_len, cache_dtype=dtype
+        )
+        label = "batched-decode"
+
+    kwargs = {} if args.pipeline else {"chunk_size": args.chunk}
+    # warmup (compile)
+    engine.generate(prompts, min(args.chunk + 1, args.new_tokens), temperature=0.0, **kwargs)
+    t0 = time.perf_counter()
+    outs, stats = engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
+    wall = time.perf_counter() - t0
+
+    toks = sum(len(o) - args.prompt_len for o in outs)
+    decode_tps = stats.tokens_generated / stats.decode_s if stats.decode_s else 0.0
+    n_chips = max(1, args.pipeline)
+    value = decode_tps / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": f"decode tokens/sec/chip ({args.model}, B={args.batch}, {label})",
+                "value": round(value, 2),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(value / REFERENCE_TOKENS_PER_S, 2),
+                "detail": {
+                    "total_tokens": toks,
+                    "decode_tokens_per_s": round(decode_tps, 2),
+                    "prefill_s": round(stats.prefill_s, 3),
+                    "wall_s": round(wall, 2),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,7 @@ class Generator:
         self.key = jax.random.PRNGKey(rng_seed)
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._decode_fns: Dict[int, Any] = {}
+        self._decode_chunk_fns: Dict[Tuple[int, int], Any] = {}
 
     # -- compiled phases -----------------------------------------------------
 
@@ -154,6 +155,39 @@ class Generator:
             self._decode_fns[B] = decode
         return self._decode_fns[B]
 
+    def _decode_chunk_fn(self, B: int, n_steps: int):
+        """K decode steps scanned inside one jit call — amortizes dispatch
+        latency (critical when the chip sits behind an RPC tunnel).  Returns
+        the K sampled tokens; stop detection happens between chunks."""
+        key_ = (B, n_steps)
+        if key_ not in self._decode_chunk_fns:
+
+            @partial(
+                jax.jit,
+                donate_argnums=(2,),
+                static_argnames=("temperature", "top_k", "top_p"),
+            )
+            def decode_chunk(params, tok0, kv, input_pos, key, temperature, top_k, top_p):
+                def body(carry, _):
+                    tok, kv, pos, key = carry
+                    logits, kv = transformer.forward(
+                        self.cfg, params, tok[:, None], pos, kv=kv, rope=self.rope
+                    )
+                    key, sub = jax.random.split(key)
+                    nxt = sample(
+                        logits[:, -1], sub,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                    ).astype(jnp.int32)
+                    return (nxt, kv, pos + 1, key), nxt
+
+                (tok, kv, pos, key), toks = jax.lax.scan(
+                    body, (tok0, kv, input_pos, key), None, length=n_steps
+                )
+                return toks, kv, key  # toks: (n_steps, B)
+
+            self._decode_chunk_fns[key_] = decode_chunk
+        return self._decode_chunk_fns[key_]
+
     # -- public API ----------------------------------------------------------
 
     def generate(
@@ -165,12 +199,18 @@ class Generator:
         top_p: Optional[float] = None,
         stop_sequences: Sequence[Sequence[int]] = (),
         stream_cb=None,
+        chunk_size: int = 16,
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for a batch of token-id prompts.
 
         Returns (full token lists incl. prompt, truncated at stop sequences)
         and timing stats.  `stream_cb(sample_idx, token)` is invoked per
         generated token when given (chat streaming).
+
+        `chunk_size` decode steps run inside one jit call (`lax.scan`) to
+        amortize host-dispatch latency; stop sequences are checked between
+        chunks, so up to chunk_size-1 extra tokens are computed then
+        discarded — the token stream itself is unchanged.
         """
         B = len(prompts)
         lens = [len(p) for p in prompts]
@@ -204,28 +244,31 @@ class Generator:
         tok = np.asarray(tok.astype(jnp.int32))
         stats.prefill_s = time.perf_counter() - t0
 
-        decode = self._decode_fn(B)
         out = [list(p) for p in prompts]
         done = [False] * B
         positions = np.asarray(lens, np.int32)
         t_dec = time.perf_counter()
 
-        for step_i in range(max_new_tokens):
+        def emit(toks_bvec, n_emitted):
             for b in range(B):
                 if not done[b]:
-                    out[b].append(int(tok[b]))
+                    out[b].append(int(toks_bvec[b]))
                     if stream_cb is not None:
-                        stream_cb(b, int(tok[b]))
+                        stream_cb(b, int(toks_bvec[b]))
                     if detect_stop_tokens(out[b][lens[b] :], stop_sequences):
                         done[b] = True
-            stats.tok_time.append((step_i + 1, time.perf_counter() - t0))
-            if all(done) or step_i == max_new_tokens - 1:
+            stats.tok_time.append((n_emitted, time.perf_counter() - t0))
+
+        n = 1
+        emit(tok, n)
+        while n < max_new_tokens and not all(done):
+            room = self.max_seq_length - int(positions.max()) - 1
+            k = min(chunk_size, max_new_tokens - n, room)
+            if k < 1:
                 break
-            if int(positions.max()) + 1 >= self.max_seq_length:
-                break
-            tok_j, kv, self.key = decode(
+            toks_j, kv, self.key = self._decode_chunk_fn(B, k)(
                 self.params,
-                jnp.asarray(tok, jnp.int32)[:, None],
+                jnp.asarray(tok, jnp.int32),
                 kv,
                 jnp.asarray(positions),
                 self.key,
@@ -233,8 +276,12 @@ class Generator:
                 top_k=top_k,
                 top_p=top_p,
             )
-            tok = np.asarray(tok_j)
-            positions = positions + 1
+            toks_np = np.asarray(toks_j)  # (k, B)
+            for i in range(k):
+                n += 1
+                emit(toks_np[i], n)
+            tok = toks_np[-1]
+            positions = positions + k
 
         stats.decode_s = time.perf_counter() - t_dec
         stats.tokens_generated = sum(len(o) - l for o, l in zip(out, lens))
