@@ -1,0 +1,88 @@
+"""Shared CLI plumbing: checkpoint/tokenizer loading, device/dtype selection.
+
+≡ reference `GPTServer._select_device`/`_init_model`/`_load_tokenizer`
+(`gptserver.py:601-749`) and `sample.py`'s auto-convert (`sample.py:66-76`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from pathlib import Path
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.utils import checkpoint as ckpt_utils
+from mdi_llm_tpu.utils.prompts import (
+    PromptStyle,
+    has_prompt_style,
+    load_prompt_style,
+    style_for_model,
+)
+from mdi_llm_tpu.utils.tokenizer import Tokenizer
+
+DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
+
+
+def add_common_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--ckpt", type=Path, default=None, help="checkpoint directory")
+    ap.add_argument(
+        "--model", default=None, help="registry model name (random init if no --ckpt)"
+    )
+    ap.add_argument("--dtype", choices=list(DTYPES), default="bfloat16")
+    ap.add_argument("--seed", type=int, default=10137)
+    ap.add_argument(
+        "--sequence-length", type=int, default=None, help="truncate max context"
+    )
+    ap.add_argument("--device", default=None, help="jax platform override (tpu/cpu)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--debug", action="store_true")
+
+
+def setup_logging(args) -> logging.Logger:
+    level = (
+        logging.DEBUG if args.debug else logging.INFO if args.verbose else logging.WARNING
+    )
+    logging.basicConfig(level=level, format="%(asctime)s %(name)s %(message)s")
+    return logging.getLogger("mdi_llm_tpu")
+
+
+def select_device(args) -> None:
+    """Device priority CLI > default (≡ gptserver.py:601-617)."""
+    if args.device:
+        jax.config.update("jax_platforms", args.device)
+
+
+def load_model(
+    args, need_tokenizer: bool = True
+) -> Tuple[Config, dict, Optional[Tokenizer], Optional[PromptStyle]]:
+    """Resolve (config, params, tokenizer, prompt_style) from --ckpt or
+    --model.  A --ckpt dir holding raw HF weights is converted in place
+    (≡ sample.py:66-76)."""
+    dtype = DTYPES[args.dtype]
+    tokenizer = prompt_style = None
+    if args.ckpt:
+        ckpt_dir = Path(args.ckpt)
+        if not ckpt_utils.has_checkpoint(ckpt_dir):
+            ckpt_utils.convert_hf_checkpoint(ckpt_dir, model_name=args.model, dtype=dtype)
+        cfg, params = ckpt_utils.load_checkpoint(ckpt_dir, dtype=dtype)
+        if need_tokenizer:
+            tokenizer = Tokenizer(ckpt_dir)
+            prompt_style = (
+                load_prompt_style(ckpt_dir)
+                if has_prompt_style(ckpt_dir)
+                else style_for_model(cfg.name)
+            )
+    elif args.model:
+        cfg = Config.from_name(args.model)
+        params = transformer.init_params(
+            cfg, jax.random.PRNGKey(args.seed), dtype=dtype
+        )
+        prompt_style = style_for_model(cfg.name)
+    else:
+        raise SystemExit("one of --ckpt or --model is required")
+    return cfg, params, tokenizer, prompt_style

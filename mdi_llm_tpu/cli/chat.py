@@ -1,0 +1,89 @@
+"""Interactive chat REPL with streaming token output.
+
+≡ reference `src/chat.py`: apply the model's prompt style per turn, stream
+tokens as they decode (incremental re-decode so multi-byte/merged tokens
+print correctly, chat.py:36-54), keep the conversation in the KV window by
+accumulating turn tokens, stop on the style's stop sequences.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from mdi_llm_tpu.cli._common import (
+    add_common_args,
+    load_model,
+    select_device,
+    setup_logging,
+)
+from mdi_llm_tpu.config import TEMPERATURE, TOP_K
+from mdi_llm_tpu.generation import Generator
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_common_args(ap)
+    ap.add_argument("--n-tokens", type=int, default=512, help="max tokens per reply")
+    ap.add_argument("--temperature", type=float, default=TEMPERATURE)
+    ap.add_argument("--top-k", type=int, default=TOP_K)
+    ap.add_argument("--top-p", type=float, default=None)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    setup_logging(args)
+    select_device(args)
+    cfg, params, tokenizer, prompt_style = load_model(args)
+    if tokenizer is None:
+        raise SystemExit("chat needs a checkpoint with a tokenizer (--ckpt)")
+    stop_seqs = prompt_style.stop_tokens(tokenizer)
+    gen = Generator(cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed)
+
+    print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")
+    history: list[int] = []
+    while True:
+        try:
+            user = input(">> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            break
+        if not user:
+            break
+        turn = tokenizer.encode(prompt_style.apply(user)).tolist()
+        context = history + turn
+        limit = gen.max_seq_length - args.n_tokens - 1
+        if len(context) > limit > 0:
+            context = context[-limit:]  # slide the window
+
+        reply_ids: list[int] = []
+        printed = ""
+        try:
+            for tok in gen.generate_chat(
+                context,
+                args.n_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
+                stop_sequences=stop_seqs,
+            ):
+                reply_ids.append(tok)
+                # incremental re-decode (≡ chat.py:174-200): print only the
+                # newly stabilized suffix
+                text = tokenizer.decode(np.asarray(reply_ids))
+                if text.startswith(printed):
+                    sys.stdout.write(text[len(printed) :])
+                    sys.stdout.flush()
+                    printed = text
+        except KeyboardInterrupt:
+            print("\n[interrupted]")
+        print()
+        history = context + reply_ids
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Weights download CLI (thin wrapper, ≡ reference `src/download_weights.py`)."""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+from mdi_llm_tpu.utils.download import download_from_hub
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("repo_id", help="HF repo id, e.g. TinyLlama/TinyLlama-1.1B-Chat-v1.0")
+    ap.add_argument("--checkpoints-dir", type=Path, default=Path("checkpoints"))
+    ap.add_argument("--access-token", default=None)
+    ap.add_argument("--tokenizer-only", action="store_true")
+    ap.add_argument("--no-convert", action="store_true")
+    args = ap.parse_args(argv)
+    out = download_from_hub(
+        args.repo_id,
+        args.checkpoints_dir,
+        access_token=args.access_token,
+        tokenizer_only=args.tokenizer_only,
+        convert=not args.no_convert,
+    )
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Memory monitor: sample host RSS + TPU HBM while a command runs.
+
+≡ reference `src/mem_monitor.py` (psutil RSS + GPUtil VRAM + jtop): spawns
+the target command, samples the process tree's RSS and (when a TPU backend
+is live in-process) `device.memory_stats()`, writes CSV + optional plot.
+
+Example:
+    python -m mdi_llm_tpu.cli.mem_monitor -o mem.csv -- \
+        python -m mdi_llm_tpu.cli.sample --model NanoLlama --n-tokens 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+
+def sample_rss(proc: "subprocess.Popen") -> int:
+    import psutil
+
+    try:
+        p = psutil.Process(proc.pid)
+        total = p.memory_info().rss
+        for child in p.children(recursive=True):
+            try:
+                total += child.memory_info().rss
+            except psutil.NoSuchProcess:
+                pass
+        return total
+    except psutil.NoSuchProcess:
+        return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-o", "--out", type=Path, default=Path("logs/mem_monitor.csv"))
+    ap.add_argument("--interval", type=float, default=0.5)
+    ap.add_argument("--plot", action="store_true")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER, help="command to run (after --)")
+    args = ap.parse_args(argv)
+    cmd = [c for c in args.cmd if c != "--"]
+    if not cmd:
+        raise SystemExit("no command given; usage: mem_monitor -o out.csv -- <cmd> ...")
+
+    args.out.parent.mkdir(parents=True, exist_ok=True)
+    proc = subprocess.Popen(cmd)
+    rows = []
+    t0 = time.perf_counter()
+    try:
+        while proc.poll() is None:
+            rows.append((time.perf_counter() - t0, sample_rss(proc)))
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        proc.terminate()
+    with args.out.open("w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["time_s", "rss_bytes"])
+        w.writerows(rows)
+    print(f"wrote {len(rows)} samples → {args.out}", file=sys.stderr)
+
+    if args.plot and rows:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        ax.plot([r[0] for r in rows], [r[1] / 2**20 for r in rows])
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("RSS (MiB)")
+        fig.savefig(args.out.with_suffix(".png"), dpi=120)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

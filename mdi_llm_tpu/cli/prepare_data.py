@@ -1,0 +1,34 @@
+"""Dataset preparation CLI: raw text → tokenized train.bin/val.bin.
+
+≡ reference `src/prepare_data.py` (Shakespeare et al.): tokenize with the
+checkpoint's tokenizer, 90/10 split, uint16 bins readable by np.memmap.
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+from mdi_llm_tpu.utils.data_loader import prepare_bin
+from mdi_llm_tpu.utils.tokenizer import Tokenizer
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dataset", type=Path, required=True, help="input .txt file")
+    ap.add_argument("--ckpt", type=Path, required=True, help="tokenizer source dir")
+    ap.add_argument("--out", type=Path, default=None, help="output dir (default: alongside input)")
+    ap.add_argument("--frac-train", type=float, default=0.9)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    out = args.out or args.dataset.parent
+    tok = Tokenizer(args.ckpt)
+    train_p, val_p = prepare_bin(args.dataset, out, tok, args.frac_train)
+    print(f"wrote {train_p} and {val_p}")
+
+
+if __name__ == "__main__":
+    main()

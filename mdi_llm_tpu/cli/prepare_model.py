@@ -1,0 +1,68 @@
+"""Model preparation CLI: download → convert → partition into stages.
+
+≡ reference `src/prepare_model.py`: fetch an HF checkpoint (or use a local
+directory), convert to the framework layout, and pre-carve per-stage
+checkpoints (`chunks/<n>stages/stage_<i>/`) + `stage_map.json` so multi-host
+pipeline deployments load only their own stage (≡ chunk files
+`chunks/<n>nodes/model_*.pth`, utils.py:388-438).
+
+Example:
+    python -m mdi_llm_tpu.cli.prepare_model TinyLlama/TinyLlama-1.1B-Chat-v1.0 --n-stages 3
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import jax.numpy as jnp
+
+from mdi_llm_tpu.parallel.partition import save_stage_manifest, split_params
+from mdi_llm_tpu.utils.checkpoint import (
+    convert_hf_checkpoint,
+    has_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("model", help="HF repo id (org/name) or local checkpoint dir")
+    ap.add_argument("--checkpoints-dir", type=Path, default=Path("checkpoints"))
+    ap.add_argument("--n-stages", "--n-nodes", type=int, default=0, dest="n_stages")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--access-token", default=None)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[args.dtype]
+
+    local = Path(args.model)
+    if local.exists():
+        ckpt_dir = local
+        if not has_checkpoint(ckpt_dir):
+            convert_hf_checkpoint(ckpt_dir, dtype=dtype)
+    else:
+        from mdi_llm_tpu.utils.download import download_from_hub
+
+        ckpt_dir = download_from_hub(
+            args.model, args.checkpoints_dir, access_token=args.access_token, dtype=dtype
+        )
+
+    if args.n_stages > 1:
+        cfg, params = load_checkpoint(ckpt_dir)
+        stages = split_params(cfg, params, args.n_stages)
+        chunk_dir = ckpt_dir / "chunks" / f"{args.n_stages}stages"
+        for i, st in enumerate(stages):
+            save_checkpoint(st, cfg, chunk_dir / f"stage_{i}")
+        save_stage_manifest(chunk_dir, cfg, args.n_stages)
+        print(f"wrote {args.n_stages} stage checkpoints → {chunk_dir}")
+    print(f"checkpoint ready: {ckpt_dir}")
+    return ckpt_dir
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Text generation CLI — single-device or pipeline-parallel.
+
+Merges the reference's `src/sample.py` (single device) and `src/starter.py`
+(distributed run with plots/timing) into one entry point: pass
+`--pipeline-stages N` to lay the model over an N-stage mesh ring (the
+reference's `--nodes-config` topology file becomes a mesh axis; multi-host
+meshes initialize via `--coordinator`/`--process-id`/`--num-processes`,
+replacing the HTTP init handshake, model_dist.py:402-497).
+
+Examples:
+    python -m mdi_llm_tpu.cli.sample --ckpt checkpoints/TinyLlama... \
+        --n-samples 3 --n-tokens 200 --prompt "FILE:prompts.txt" --plots
+    python -m mdi_llm_tpu.cli.sample --model NanoLlama --pipeline-stages 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from mdi_llm_tpu.cli._common import (
+    add_common_args,
+    load_model,
+    select_device,
+    setup_logging,
+)
+from mdi_llm_tpu.config import TEMPERATURE, TOP_K
+from mdi_llm_tpu.utils import plots
+from mdi_llm_tpu.utils.prompts import get_user_prompt
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_common_args(ap)
+    ap.add_argument("--n-samples", type=int, default=1)
+    ap.add_argument("--n-tokens", type=int, default=300, help="tokens per sample")
+    ap.add_argument("--prompt", default="Once upon a time,", help='text or "FILE:<path>"')
+    ap.add_argument("--temperature", type=float, default=TEMPERATURE)
+    ap.add_argument("--top-k", type=int, default=TOP_K)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
+    ap.add_argument("--greedy", action="store_true", help="temperature 0 (parity mode)")
+    ap.add_argument("--pipeline-stages", type=int, default=0)
+    ap.add_argument("--plots", action="store_true")
+    ap.add_argument("--time-run", type=Path, default=None, help="append run stats CSV")
+    ap.add_argument("--logs-dir", type=Path, default=Path("logs"))
+    # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
+    ap.add_argument("--coordinator", default=None, help="host:port of process 0")
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--num-processes", type=int, default=None)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    log = setup_logging(args)
+    select_device(args)
+    if args.coordinator:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+
+    cfg, params, tokenizer, prompt_style = load_model(args)
+    log.info("model %s: %d layers, %d params", cfg.name, cfg.n_layer, -1)
+
+    raw_prompts = get_user_prompt(args.prompt, args.n_samples)
+    if tokenizer is not None:
+        styled = [prompt_style.apply(p) for p in raw_prompts]
+        prompt_ids = [tokenizer.encode(p).tolist() for p in styled]
+        stop_seqs = prompt_style.stop_tokens(tokenizer)
+    else:
+        rng = np.random.default_rng(args.seed)
+        prompt_ids = [rng.integers(1, cfg.vocab_size, 8).tolist() for _ in raw_prompts]
+        stop_seqs = ()
+
+    temperature = 0.0 if args.greedy else args.temperature
+    seq_len = args.sequence_length
+
+    t_load = time.perf_counter()
+    if args.pipeline_stages:
+        from mdi_llm_tpu.parallel.pipeline import PipelineEngine
+
+        engine = PipelineEngine(
+            cfg, params, n_stages=args.pipeline_stages, max_seq_length=seq_len,
+            rng_seed=args.seed,
+        )
+        n_nodes = args.pipeline_stages
+        outs, stats = engine.generate(
+            prompt_ids, args.n_tokens, temperature=temperature,
+            top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
+        )
+    else:
+        from mdi_llm_tpu.generation import Generator
+
+        engine = Generator(cfg, params, max_seq_length=seq_len, rng_seed=args.seed)
+        n_nodes = 1
+        outs, stats = engine.generate(
+            prompt_ids, args.n_tokens, temperature=temperature,
+            top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
+            chunk_size=args.chunk,
+        )
+    gen_time = time.perf_counter() - t_load
+
+    for i, (ids, plen) in enumerate(zip(outs, (len(p) for p in prompt_ids))):
+        print(f"--- sample {i} ({len(ids) - plen} new tokens) " + "-" * 30)
+        if tokenizer is not None:
+            print(tokenizer.decode(np.asarray(ids)))
+        else:
+            print(ids)
+    print(
+        f"[{n_nodes} node(s)] {stats.tokens_generated} tokens in "
+        f"{gen_time:.2f}s — {stats.tokens_per_s:.2f} tok/s decode "
+        f"(prefill {stats.prefill_s:.2f}s)",
+        file=sys.stderr,
+    )
+
+    if args.plots or args.time_run:
+        csv_path = plots.tok_time_csv_path(
+            args.logs_dir, n_nodes, cfg.name, args.n_samples
+        )
+        plots.write_tok_time_csv(csv_path, stats.tok_time)
+        if args.plots:
+            plots.plot_tokens_per_time(
+                stats.tok_time,
+                csv_path.with_suffix(".png"),
+                label=f"{cfg.name} {n_nodes} node(s)",
+            )
+        if args.time_run:
+            plots.append_run_stats(
+                args.time_run,
+                args.n_samples,
+                cfg.n_layer,
+                seq_len or cfg.block_size,
+                gen_time,
+            )
+    return outs
+
+
+if __name__ == "__main__":
+    main()

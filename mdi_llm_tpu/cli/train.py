@@ -1,0 +1,134 @@
+"""Training CLI.
+
+≡ reference `src/train.py` argparse surface: init from scratch / resume /
+converted-HF weights, token-bin dataset dir, gradient accumulation, periodic
+eval + checkpoint with patience.  The DDP/torchrun path becomes `--mesh`
+("dp=8" or "dp=4,tp=2") on one host, plus `--coordinator/--process-id/
+--num-processes` for multi-host `jax.distributed`.
+
+Example:
+    python -m mdi_llm_tpu.cli.train --ckpt checkpoints/custom/NanoLlama \
+        --dataset data/shakespeare --batch-size 8 --grad-acc-steps 4 \
+        --max-iters 5000 --mesh dp=4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from mdi_llm_tpu.cli._common import add_common_args, select_device, setup_logging
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.parallel.mesh import make_mesh
+from mdi_llm_tpu.training import Trainer, TrainingConfig
+from mdi_llm_tpu.utils import data_loader
+from mdi_llm_tpu.utils.checkpoint import has_checkpoint, load_checkpoint
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_common_args(ap)
+    ap.add_argument("--dataset", type=Path, required=True, help="dir with train.bin/val.bin")
+    ap.add_argument("--init", choices=["scratch", "resume", "hf"], default="scratch")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--grad-acc-steps", type=int, default=1)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--max-iters", type=int, default=600000)
+    ap.add_argument("--learning-rate", type=float, default=3e-4)
+    ap.add_argument("--warmup-iters", type=int, default=2000)
+    ap.add_argument("--lr-decay-iters", type=int, default=600000)
+    ap.add_argument("--min-lr", type=float, default=6e-5)
+    ap.add_argument("--weight-decay", type=float, default=1e-1)
+    ap.add_argument("--grad-clip", type=float, default=1.0)
+    ap.add_argument("--ckpt-interval", type=int, default=1000)
+    ap.add_argument("--eval-iters", type=int, default=20)
+    ap.add_argument("--log-interval", type=int, default=10)
+    ap.add_argument("--patience", type=int, default=5)
+    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--mesh", default=None, help='e.g. "dp=8" or "dp=4,tp=2"')
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--num-processes", type=int, default=None)
+    return ap
+
+
+def parse_mesh(spec):
+    if not spec:
+        return None
+    axes = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    return make_mesh(axes)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    log = setup_logging(args)
+    select_device(args)
+    if args.coordinator:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+
+    tc = TrainingConfig(
+        batch_size=args.batch_size,
+        block_size=args.block_size,
+        grad_acc_steps=args.grad_acc_steps,
+        learning_rate=args.learning_rate,
+        warmup_iters=args.warmup_iters,
+        lr_decay_iters=args.lr_decay_iters,
+        min_lr=args.min_lr,
+        weight_decay=args.weight_decay,
+        grad_clip=args.grad_clip,
+        max_iters=args.max_iters,
+        eval_iters=args.eval_iters,
+        ckpt_interval=args.ckpt_interval,
+        log_interval=args.log_interval,
+        patience=args.patience,
+        seed=args.seed,
+        dtype=args.dtype if args.dtype != "float16" else "bfloat16",
+        remat=not args.no_remat,
+    )
+    mesh = parse_mesh(args.mesh)
+    out_dir = Path(args.ckpt) if args.ckpt else Path("out")
+
+    if args.init == "resume":
+        trainer = Trainer.resume(out_dir, mesh=mesh)
+        log.info("resumed at iter %d", trainer.iter_num)
+    else:
+        if args.init == "hf" or (args.ckpt and has_checkpoint(out_dir)):
+            cfg, params = load_checkpoint(out_dir)
+        else:
+            cfg = (
+                Config.from_checkpoint(out_dir)
+                if (out_dir / "model_config.yaml").exists()
+                else Config.from_name(args.model or out_dir.name)
+            )
+            params = None
+        trainer = Trainer(cfg, tc, mesh=mesh, params=params, out_dir=out_dir)
+
+    train = data_loader.open_bin(args.dataset / "train.bin")
+    val_p = args.dataset / "val.bin"
+    val = data_loader.open_bin(val_p) if val_p.exists() else None
+
+    def log_cb(entry):
+        print(json.dumps(entry))
+
+    result = trainer.fit(train, val, max_iters=args.max_iters, log_cb=log_cb)
+    trainer.save(out_dir)
+    print(
+        f"finished at iter {result['iter_num']}, best val loss "
+        f"{result['best_val_loss']:.4f} → {out_dir}"
+    )
+    return result
+
+
+if __name__ == "__main__":
+    main()

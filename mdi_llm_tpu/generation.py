@@ -102,11 +102,13 @@ class Generator:
         cfg: Config,
         params: Any,
         max_seq_length: Optional[int] = None,
-        cache_dtype=jnp.bfloat16,
+        cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
     ):
         self.cfg = cfg
         self.params = params
+        if cache_dtype is None:
+            cache_dtype = jax.tree_util.tree_leaves(params)[0].dtype
         self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
         self.cache_dtype = cache_dtype
         self.rope = transformer.get_rope_cache(cfg)

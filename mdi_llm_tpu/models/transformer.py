@@ -163,9 +163,10 @@ def attention_forward(
         )
 
     if k_cache is not None:
-        # scatter this chunk into the cache at each sample's offset
+        # scatter this chunk into the cache at each sample's offset (cache
+        # may be narrower than the compute dtype, e.g. bf16 cache, f32 math)
         def upd(cache, new, off):
-            return jax.lax.dynamic_update_slice(cache, new, (0, off, 0))
+            return jax.lax.dynamic_update_slice(cache, new.astype(cache.dtype), (0, off, 0))
 
         k_cache = jax.vmap(upd)(k_cache, k, input_pos)
         v_cache = jax.vmap(upd)(v_cache, v, input_pos)
@@ -179,7 +180,7 @@ def attention_forward(
 
     # litGPT scales by 1/sqrt(head_size) (model.py:738-751)
     y = multihead_attention(q, k_att, v_att, pos, kv_valid, k_pos=k_pos)
-    y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size)
+    y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size).astype(x.dtype)
     return linear(y, p["proj"]), k_cache, v_cache
 
 

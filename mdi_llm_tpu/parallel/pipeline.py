@@ -88,10 +88,12 @@ class PipelineEngine:
         n_stages: Optional[int] = None,
         mesh: Optional[Mesh] = None,
         max_seq_length: Optional[int] = None,
-        cache_dtype=jnp.bfloat16,
+        cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
         devices: Optional[Sequence] = None,
     ):
+        if cache_dtype is None:
+            cache_dtype = jax.tree_util.tree_leaves(params)[0].dtype
         if mesh is None:
             mesh = pipeline_mesh(n_stages or len(devices or jax.devices()), devices)
         self.mesh = mesh

@@ -1,0 +1,61 @@
+"""HuggingFace Hub checkpoint download.
+
+Parity with the reference downloader
+(`/root/reference/src/sub/utils/download.py:15-182`): pattern-filtered
+snapshot download (tokenizer + weights), safetensors preferred, friendly
+errors for gated/nonexistent repos, then conversion to the framework's
+checkpoint layout.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Union
+
+PathLike = Union[str, Path]
+
+_WEIGHT_PATTERNS = ["*.safetensors*", "*.bin*", "*.json", "tokenizer.model"]
+
+
+def download_from_hub(
+    repo_id: str,
+    checkpoints_dir: PathLike = "checkpoints",
+    access_token: Optional[str] = None,
+    tokenizer_only: bool = False,
+    convert: bool = True,
+    dtype=None,
+) -> Path:
+    """Download `org/name` into checkpoints/<org>/<name> and convert.
+
+    ≡ reference `download_from_hub` (download.py:15-123); conversion goes
+    straight to the orbax pytree layout (no intermediate lit_model.pth).
+    """
+    from huggingface_hub import snapshot_download
+    from huggingface_hub.utils import GatedRepoError, RepositoryNotFoundError
+
+    out = Path(checkpoints_dir) / repo_id
+    patterns = (
+        ["tokenizer*", "*.json", "*.model"] if tokenizer_only else _WEIGHT_PATTERNS
+    )
+    try:
+        snapshot_download(
+            repo_id,
+            local_dir=out,
+            allow_patterns=patterns,
+            token=access_token,
+        )
+    except GatedRepoError as e:  # pragma: no cover - needs network
+        raise RuntimeError(
+            f"{repo_id} is a gated repo: accept the license on huggingface.co and "
+            "pass --access-token (≡ reference gated_repo_catcher)"
+        ) from e
+    except RepositoryNotFoundError as e:  # pragma: no cover - needs network
+        raise RuntimeError(f"repository {repo_id!r} not found on the HF hub") from e
+
+    if convert and not tokenizer_only:
+        import jax.numpy as jnp
+
+        from mdi_llm_tpu.utils.checkpoint import convert_hf_checkpoint
+
+        convert_hf_checkpoint(out, dtype=dtype or jnp.bfloat16)
+    return out

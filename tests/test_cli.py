@@ -1,0 +1,157 @@
+"""CLI end-to-end tests: sample (single + pipeline), prepare_data, train,
+prepare_model partitioning, plot overlay.  Uses a tiny HF llama checkpoint +
+word-level tokenizer built on the fly."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    """A full checkpoint dir: converted weights + tokenizer + configs."""
+    torch = pytest.importorskip("torch")
+    from tokenizers import Tokenizer as HFTok
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from mdi_llm_tpu.utils.checkpoint import convert_hf_checkpoint
+
+    d = tmp_path_factory.mktemp("ckpt") / "tiny-llama-test"
+    hf_cfg = LlamaConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf_cfg).save_pretrained(d)
+
+    words = "the quick brown fox jumps over lazy dog and cat runs far".split()
+    vocab = {"<s>": 0, "</s>": 1, "<unk>": 2}
+    for w in words:
+        vocab[w] = len(vocab)
+    t = HFTok(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Whitespace()
+    t.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(
+        json.dumps({"bos_token": "<s>", "eos_token": "</s>", "add_bos_token": False})
+    )
+    convert_hf_checkpoint(d, dtype=jnp.float32)
+    return d
+
+
+def test_sample_cli_single_device(tiny_ckpt, tmp_path, capsys):
+    from mdi_llm_tpu.cli.sample import main
+
+    outs = main(
+        [
+            "--ckpt", str(tiny_ckpt),
+            "--dtype", "float32",
+            "--n-samples", "2",
+            "--n-tokens", "6",
+            "--prompt", "the quick brown fox",
+            "--greedy",
+            "--plots",
+            "--time-run", str(tmp_path / "stats.csv"),
+            "--logs-dir", str(tmp_path / "logs"),
+        ]
+    )
+    assert len(outs) == 2 and all(len(o) > 4 for o in outs)
+    captured = capsys.readouterr()
+    assert "sample 0" in captured.out and "sample 1" in captured.out
+    csvs = list((tmp_path / "logs").glob("tokens_time_samples_1nodes_*_2samples.csv"))
+    assert len(csvs) == 1
+    assert (tmp_path / "stats.csv").exists()
+    assert csvs[0].with_suffix(".png").exists()
+
+
+def test_sample_cli_pipeline_matches_single(tiny_ckpt, tmp_path, devices):
+    from mdi_llm_tpu.cli.sample import main
+
+    common = [
+        "--ckpt", str(tiny_ckpt),
+        "--dtype", "float32",
+        "--n-samples", "2",
+        "--n-tokens", "5",
+        "--prompt", "lazy dog runs",
+        "--greedy",
+    ]
+    single = main(common)
+    piped = main(common + ["--pipeline-stages", "3"])
+    assert piped == single
+
+
+def test_prepare_data_and_train_cli(tiny_ckpt, tmp_path):
+    from mdi_llm_tpu.cli.prepare_data import main as prep_main
+    from mdi_llm_tpu.cli.train import main as train_main
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over lazy dog " * 400)
+    prep_main(
+        ["--dataset", str(corpus), "--ckpt", str(tiny_ckpt), "--out", str(tmp_path / "data")]
+    )
+    assert (tmp_path / "data" / "train.bin").exists()
+
+    out_dir = tmp_path / "run"
+    out_dir.mkdir()
+    # copy model config so the trainer builds the tiny architecture
+    (out_dir / "model_config.yaml").write_text(
+        (tiny_ckpt / "model_config.yaml").read_text()
+    )
+    result = train_main(
+        [
+            "--ckpt", str(out_dir),
+            "--dataset", str(tmp_path / "data"),
+            "--dtype", "float32",
+            "--batch-size", "2",
+            "--block-size", "16",
+            "--max-iters", "4",
+            "--ckpt-interval", "2",
+            "--eval-iters", "1",
+            "--log-interval", "2",
+            "--no-remat",
+        ]
+    )
+    assert result["iter_num"] == 4
+    assert (out_dir / "params").exists()
+    # resume path
+    result2 = train_main(
+        [
+            "--ckpt", str(out_dir),
+            "--dataset", str(tmp_path / "data"),
+            "--init", "resume",
+            "--max-iters", "6",
+        ]
+    )
+    assert result2["iter_num"] == 6
+
+
+def test_prepare_model_cli_stages(tiny_ckpt):
+    from mdi_llm_tpu.cli.prepare_model import main
+
+    out = main([str(tiny_ckpt), "--n-stages", "3", "--dtype", "float32"])
+    chunk_dir = out / "chunks" / "3stages"
+    assert (chunk_dir / "stage_map.json").exists()
+    for i in range(3):
+        assert (chunk_dir / f"stage_{i}" / "params").exists()
+    manifest = json.loads((chunk_dir / "stage_map.json").read_text())
+    assert sum(manifest["stage_layers"]) == 3
+
+
+def test_chat_cli_scripted(tiny_ckpt, monkeypatch, capsys):
+    from mdi_llm_tpu.cli import chat
+
+    inputs = iter(["the quick brown", ""])
+    monkeypatch.setattr("builtins.input", lambda *_: next(inputs))
+    chat.main(["--ckpt", str(tiny_ckpt), "--dtype", "float32", "--n-tokens", "5"])
+    out = capsys.readouterr().out
+    assert "Chatting with" in out
