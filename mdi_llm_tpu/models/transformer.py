@@ -142,6 +142,7 @@ def attention_forward(
     k_cache: Optional[jnp.ndarray],  # (B, G, S, hs) or None
     v_cache: Optional[jnp.ndarray],
     input_pos: Optional[jnp.ndarray],  # (B,) write offset into the cache
+    sp_axis: Optional[str] = None,  # sequence-parallel mesh axis (ring attn)
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     B, T, D = x.shape
     qkv = linear(x, p["qkv"])
@@ -178,8 +179,15 @@ def attention_forward(
         kv_valid = None
         k_pos = pos  # uncached chunk: keys sit at the query positions
 
-    # litGPT scales by 1/sqrt(head_size) (model.py:738-751)
-    y = multihead_attention(q, k_att, v_att, pos, kv_valid, k_pos=k_pos)
+    if sp_axis is not None:
+        if k_cache is not None:
+            raise NotImplementedError("ring attention with KV cache: use dense per-chunk")
+        from mdi_llm_tpu.ops.ring_attention import ring_attention
+
+        y = ring_attention(q, k_att, v_att, pos, k_pos, sp_axis)
+    else:
+        # litGPT scales by 1/sqrt(head_size) (model.py:738-751)
+        y = multihead_attention(q, k_att, v_att, pos, kv_valid, k_pos=k_pos)
     y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size).astype(x.dtype)
     return linear(y, p["proj"]), k_cache, v_cache
 
@@ -199,12 +207,13 @@ def block_forward(
     k_cache: Optional[jnp.ndarray],
     v_cache: Optional[jnp.ndarray],
     input_pos: Optional[jnp.ndarray],
+    sp_axis: Optional[str] = None,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
     parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms."""
     n1 = _norm(cfg, x, p["norm_1"])
     att, k_cache, v_cache = attention_forward(
-        cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos
+        cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
@@ -225,6 +234,7 @@ def run_blocks(
     kv: Optional[KVCache] = None,  # k/v: (L_stage, B, G, S, hs)
     input_pos: Optional[jnp.ndarray] = None,  # (B,)
     remat: bool = False,
+    sp_axis: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
     rematerializes each block under autodiff (training memory ∝ 1 layer's
@@ -235,7 +245,7 @@ def run_blocks(
 
         def body(carry, layer_p):
             y, _, _ = block_forward(
-                cfg, layer_p, carry, pos, cos, sin, None, None, input_pos
+                cfg, layer_p, carry, pos, cos, sin, None, None, input_pos, sp_axis
             )
             return y, None
 
@@ -289,12 +299,15 @@ def forward(
     kv: Optional[KVCache] = None,
     rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     remat: bool = False,
+    sp_axis: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
 
     Works for prefill (T = prompt chunk) and decode (T = 1) alike; the same
     traced function is reused whenever shapes match (shape-bucketing lives in
-    `generation.py`).
+    `generation.py`).  With `sp_axis` (inside a shard_map over that axis),
+    `tokens` is the LOCAL sequence chunk and `input_pos` its absolute start —
+    attention runs as ring attention over the distributed sequence.
     """
     B, T = tokens.shape
     pos = input_pos[:, None] + jnp.arange(T, dtype=input_pos.dtype)[None, :]
@@ -304,7 +317,8 @@ def forward(
     sin = jnp.take(rope[1], pos, axis=0)
     x = embed(cfg, params, tokens, pos)
     x, kv = run_blocks(
-        cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat
+        cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat,
+        sp_axis=sp_axis,
     )
     return head(cfg, params, x), kv
 

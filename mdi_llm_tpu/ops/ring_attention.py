@@ -1,0 +1,89 @@
+"""Ring attention: causal attention with the sequence sharded over a mesh
+axis.
+
+Long-context/sequence parallelism is absent from the reference
+(SURVEY.md §5.7 — context is bounded by one device's memory); on TPU it is a
+first-class design axis.  This implements blockwise ring attention
+(Liu et al., "Ring Attention with Blockwise Transformers"-style): each
+device on the `sp` axis holds a sequence chunk of Q, K, V; K/V chunks (with
+their absolute positions) rotate around the ring via `jax.lax.ppermute`
+while each device accumulates its queries' attention with an online-softmax
+(running max / denominator / weighted sum), so the full (T, T) score matrix
+is never materialized and context length scales linearly with the number of
+devices.
+
+Must be called inside a `shard_map` context where `axis_name` is a mesh
+axis.  Numerics: f32 accumulators, output matches dense attention to
+~1e-6 (pinned by tests against `multihead_attention`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,  # (B, n_head, Tq_local, hs)
+    k: jnp.ndarray,  # (B, n_groups, Tk_local, hs)
+    v: jnp.ndarray,  # (B, n_groups, Tk_local, hs)
+    q_pos: jnp.ndarray,  # (B, Tq_local) absolute query positions
+    k_pos: jnp.ndarray,  # (B, Tk_local) absolute key positions (local chunk)
+    axis_name: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Returns (B, n_head, Tq_local, hs) — attention of the local queries
+    over the ENTIRE (distributed) key/value sequence."""
+    B, n_head, Tq, hs = q.shape
+    _, n_groups, Tk, _ = k.shape
+    if scale is None:
+        scale = 1.0 / (hs**0.5)
+    P = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    q_per_kv = n_head // n_groups
+    qg = q.reshape(B, n_groups, q_per_kv, Tq, hs)
+
+    # derive accumulators from q so they inherit q's varying mesh axes (JAX
+    # vma typing: the scan carry becomes device-varying after the first
+    # ppermute round; fresh constants would type as unvarying and mismatch)
+    zero = (qg[..., 0] * 0.0).astype(jnp.float32)  # (B, G, q_per_kv, Tq)
+    m0 = zero + NEG_INF
+    l0 = zero
+    o0 = (qg * 0.0).astype(jnp.float32)
+
+    def body(carry, _):
+        k_c, v_c, kp_c, m, l, o = carry
+        s = jnp.einsum(
+            "bgqth,bgsh->bgqts", qg, k_c, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            mask = kp_c[:, None, :] <= q_pos[:, :, None]  # (B, Tq, Tk)
+            s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+
+        m_chunk = jnp.max(s, axis=-1)  # (B, g, q, Tq)
+        m_new = jnp.maximum(m, m_chunk)
+        # guard fully-masked rows: keep exp argument finite
+        alpha = jnp.exp(jnp.maximum(m - m_new, -80.0))
+        p = jnp.exp(jnp.maximum(s - m_new[..., None], -80.0))
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bgqts,bgsh->bgqth", p, v_c.astype(jnp.float32)
+        )
+        # rotate the K/V chunk (and its positions) to the next device
+        k_n = jax.lax.ppermute(k_c, axis_name, perm)
+        v_n = jax.lax.ppermute(v_c, axis_name, perm)
+        kp_n = jax.lax.ppermute(kp_c, axis_name, perm)
+        return (k_n, v_n, kp_n, m_new, l, o), None
+
+    (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
+        body, (k, v, k_pos, m0, l0, o0), None, length=P
+    )
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(B, n_head, Tq, hs).astype(q.dtype)

@@ -173,8 +173,11 @@ class Trainer:
 
         self.optimizer = make_optimizer(tc)
 
+        self.sp = mesh is not None and "sp" in mesh.axis_names
         if mesh is not None:
-            tp = "tp" if "tp" in mesh.axis_names else None
+            # sequence parallelism uses explicit shard_map collectives; params
+            # stay replicated there (tp+sp composition is future work)
+            tp = "tp" if ("tp" in mesh.axis_names and not self.sp) else None
             pspecs = param_specs(cfg, tp)
             self.param_shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s), pspecs
@@ -182,7 +185,8 @@ class Trainer:
             params = jax.tree_util.tree_map(
                 jax.device_put, params, self.param_shardings
             )
-            self.batch_sharding = NamedSharding(mesh, P("dp", None))
+            seq_axis = "sp" if self.sp else None
+            self.batch_sharding = NamedSharding(mesh, P("dp", seq_axis))
         else:
             self.param_shardings = None
             self.batch_sharding = None
@@ -194,11 +198,49 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
+    def _sp_loss_fn(self):
+        """Sequence-parallel loss: shard_map over (dp, sp); each device holds
+        a sequence chunk, attention rides the ring (ops.ring_attention), the
+        scalar loss is psum-reduced.  jax.grad differentiates through the
+        shard_map (psum transposes handled by JAX)."""
+        cfg, tc, mesh = self.cfg, self.tc, self.mesh
+
+        def local_loss(params, x, y):
+            start = jax.lax.axis_index("sp") * x.shape[1]
+            input_pos = jnp.full((x.shape[0],), start, jnp.int32)
+            logits, _ = transformer.forward(
+                cfg, params, x, input_pos, remat=tc.remat, sp_axis="sp"
+            )
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y
+            )
+            total = jax.lax.psum(losses.sum(), ("dp", "sp"))
+            count = jax.lax.psum(
+                jnp.asarray(losses.size, jnp.float32), ("dp", "sp")
+            )
+            return total / count
+
+        repl = jax.tree_util.tree_map(lambda _: P(), self.params)
+        return jax.shard_map(
+            local_loss,
+            mesh=mesh,
+            in_specs=(repl, P("dp", "sp"), P("dp", "sp")),
+            out_specs=P(),
+        )
+
     def _build_step(self):
         cfg, tc = self.cfg, self.tc
 
-        def loss_fn(params, x, y):
-            return cross_entropy_loss(cfg, params, x, y, remat=tc.remat)
+        if self.sp:
+            sp_loss = self._sp_loss_fn()
+
+            def loss_fn(params, x, y):
+                return sp_loss(params, x, y)
+
+        else:
+
+            def loss_fn(params, x, y):
+                return cross_entropy_loss(cfg, params, x, y, remat=tc.remat)
 
         def step(params, opt_state, xs, ys):
             # gradient accumulation: scan micro-batches, mean the grads
@@ -220,23 +262,24 @@ class Trainer:
         donate = (0, 1)
         if self.mesh is None:
             return jax.jit(step, donate_argnums=donate)
+        seq_axis = "sp" if self.sp else None
+        micro_batch_sh = NamedSharding(self.mesh, P(None, "dp", seq_axis))
         return jax.jit(
             step,
             donate_argnums=donate,
-            in_shardings=(
-                self.param_shardings,
-                None,
-                NamedSharding(self.mesh, P(None, "dp", None)),
-                NamedSharding(self.mesh, P(None, "dp", None)),
-            ),
+            in_shardings=(self.param_shardings, None, micro_batch_sh, micro_batch_sh),
             out_shardings=(self.param_shardings, None, None),
         )
 
     def _build_eval(self):
         cfg = self.cfg
 
-        def ev(params, x, y):
-            return cross_entropy_loss(cfg, params, x, y, remat=False)
+        if self.sp:
+            ev = self._sp_loss_fn()
+        else:
+
+            def ev(params, x, y):
+                return cross_entropy_loss(cfg, params, x, y, remat=False)
 
         if self.mesh is None:
             return jax.jit(ev)
