@@ -104,11 +104,15 @@ class Generator:
         max_seq_length: Optional[int] = None,
         cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
+        use_flash: Optional[bool] = None,  # None → auto (TPU backend)
     ):
         self.cfg = cfg
         self.params = params
         if cache_dtype is None:
             cache_dtype = jax.tree_util.tree_leaves(params)[0].dtype
+        if use_flash is None:
+            use_flash = jax.default_backend() == "tpu"
+        self.use_flash = use_flash
         self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
         self.cache_dtype = cache_dtype
         self.rope = transformer.get_rope_cache(cfg)
@@ -131,6 +135,9 @@ class Generator:
                     jnp.zeros((tokens.shape[0],), jnp.int32),
                     kv=kv,
                     rope=self.rope,
+                    fresh_prefill=True,
+                    # flash pays off on big tiles; tiny buckets stay on XLA
+                    use_flash=self.use_flash and T >= 256,
                 )
                 last = jnp.take_along_axis(
                     logits, (true_len - 1)[:, None, None], axis=1

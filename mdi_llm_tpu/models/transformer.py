@@ -143,6 +143,9 @@ def attention_forward(
     v_cache: Optional[jnp.ndarray],
     input_pos: Optional[jnp.ndarray],  # (B,) write offset into the cache
     sp_axis: Optional[str] = None,  # sequence-parallel mesh axis (ring attn)
+    fresh_prefill: bool = False,  # input_pos==0 and cache empty: attend the
+    # chunk itself (T×T) instead of the full cache buffer (T×S)
+    use_flash: bool = False,  # pallas flash kernel on the chunk path
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     B, T, D = x.shape
     qkv = linear(x, p["qkv"])
@@ -171,10 +174,14 @@ def attention_forward(
 
         k_cache = jax.vmap(upd)(k_cache, k, input_pos)
         v_cache = jax.vmap(upd)(v_cache, v, input_pos)
+
+    if k_cache is not None and not fresh_prefill:
         k_att, v_att = k_cache, v_cache
         kv_valid = input_pos + T  # (B,)
         k_pos = None  # cache slot j holds absolute position j
     else:
+        # no cache, or a fresh prefill at offset 0: attend the chunk itself
+        # (T×T instead of T×cache_len — and flash-eligible)
         k_att, v_att = k, v
         kv_valid = None
         k_pos = pos  # uncached chunk: keys sit at the query positions
@@ -185,6 +192,11 @@ def attention_forward(
         from mdi_llm_tpu.ops.ring_attention import ring_attention
 
         y = ring_attention(q, k_att, v_att, pos, k_pos, sp_axis)
+    elif use_flash and kv_valid is None and T > 1:
+        from mdi_llm_tpu.ops.flash import flash_attention
+
+        # flash path assumes q_pos == k_pos == arange(T) (fresh chunk at 0)
+        y = flash_attention(q, k_att, v_att)
     else:
         # litGPT scales by 1/sqrt(head_size) (model.py:738-751)
         y = multihead_attention(q, k_att, v_att, pos, kv_valid, k_pos=k_pos)
@@ -208,12 +220,15 @@ def block_forward(
     v_cache: Optional[jnp.ndarray],
     input_pos: Optional[jnp.ndarray],
     sp_axis: Optional[str] = None,
+    fresh_prefill: bool = False,
+    use_flash: bool = False,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
     parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms."""
     n1 = _norm(cfg, x, p["norm_1"])
     att, k_cache, v_cache = attention_forward(
-        cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis
+        cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis,
+        fresh_prefill, use_flash,
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
@@ -235,6 +250,8 @@ def run_blocks(
     input_pos: Optional[jnp.ndarray] = None,  # (B,)
     remat: bool = False,
     sp_axis: Optional[str] = None,
+    fresh_prefill: bool = False,
+    use_flash: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
     rematerializes each block under autodiff (training memory ∝ 1 layer's
@@ -245,7 +262,8 @@ def run_blocks(
 
         def body(carry, layer_p):
             y, _, _ = block_forward(
-                cfg, layer_p, carry, pos, cos, sin, None, None, input_pos, sp_axis
+                cfg, layer_p, carry, pos, cos, sin, None, None, input_pos, sp_axis,
+                fresh_prefill, use_flash,
             )
             return y, None
 
@@ -257,7 +275,8 @@ def run_blocks(
     def body(carry, xs):
         layer_p, k_c, v_c = xs
         y, k_c, v_c = block_forward(
-            cfg, layer_p, carry, pos, cos, sin, k_c, v_c, input_pos
+            cfg, layer_p, carry, pos, cos, sin, k_c, v_c, input_pos,
+            fresh_prefill=fresh_prefill, use_flash=use_flash,
         )
         return y, (k_c, v_c)
 
@@ -300,6 +319,8 @@ def forward(
     rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     remat: bool = False,
     sp_axis: Optional[str] = None,
+    fresh_prefill: bool = False,
+    use_flash: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
 
@@ -308,6 +329,10 @@ def forward(
     `generation.py`).  With `sp_axis` (inside a shard_map over that axis),
     `tokens` is the LOCAL sequence chunk and `input_pos` its absolute start —
     attention runs as ring attention over the distributed sequence.
+
+    `fresh_prefill` (caller contract: input_pos == 0, cache empty) attends
+    over the chunk itself rather than the cache buffer, enabling the Pallas
+    flash kernel via `use_flash` (inference only — no custom VJP yet).
     """
     B, T = tokens.shape
     pos = input_pos[:, None] + jnp.arange(T, dtype=input_pos.dtype)[None, :]
@@ -318,7 +343,7 @@ def forward(
     x = embed(cfg, params, tokens, pos)
     x, kv = run_blocks(
         cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat,
-        sp_axis=sp_axis,
+        sp_axis=sp_axis, fresh_prefill=fresh_prefill, use_flash=use_flash,
     )
     return head(cfg, params, x), kv
 

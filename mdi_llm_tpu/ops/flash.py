@@ -1,0 +1,139 @@
+"""Pallas TPU flash attention (causal self-attention prefill).
+
+The reference delegates its fused attention to torch SDPA/cuDNN
+(`/root/reference/src/sub/model.py:738-751`); this is the TPU-native
+equivalent for the O(T²) prefill path: a Pallas kernel that streams K/V
+blocks through VMEM with an online softmax, never materializing the (T, T)
+score matrix.  GQA is handled by mapping each query head's grid slot to its
+KV group in the BlockSpec index maps.
+
+Scope: causal self-attention over one fresh chunk (q_pos == k_pos ==
+arange(T)) — exactly the generation prefill and training shapes.  Decode
+(T=1) is memory-bound and stays on the XLA path.  Falls back automatically
+unless running on TPU (or `interpret=True` for CPU tests).
+
+Kernel structure (per pallas_guide.md): grid (B, H, Tq/BQ); each program
+holds one (BQ, hs) query tile in VMEM and fori-loops over K tiles up to the
+causal frontier with running (m, l, acc) scratch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, seq_len):
+    # blocks carry leading (1, 1) batch/head dims: q_ref (1,1,BQ,hs),
+    # k_ref/v_ref (1,1,Tk,hs), o_ref (1,1,BQ,hs)
+    block_q = q_ref.shape[2]
+    hs = q_ref.shape[3]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, hs), jnp.float32)
+
+    # causal frontier: last K block index that any query in this tile sees
+    num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        q_idx = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_idx = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (k_idx <= q_idx) & (k_idx < seq_len)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_chunk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, m_chunk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mask, p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,  # (B, n_head, T, hs)
+    k: jnp.ndarray,  # (B, n_groups, T, hs)
+    v: jnp.ndarray,  # (B, n_groups, T, hs)
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal flash self-attention; returns (B, n_head, T, hs)."""
+    B, H, T, hs = q.shape
+    _, G, Tk, _ = k.shape
+    assert T == Tk, "flash path is self-attention over one chunk"
+    if scale is None:
+        scale = 1.0 / (hs**0.5)
+    q_per_kv = H // G
+
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    # pad T to a multiple of the blocks (masked out via seq_len)
+    T_pad = ((T + block_q - 1) // block_q) * block_q
+    T_pad = ((T_pad + block_k - 1) // block_k) * block_k
+    if T_pad != T:
+        pad = [(0, 0), (0, 0), (0, T_pad - T), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_k=block_k, seq_len=T
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, T_pad // block_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, hs),
+                lambda b, h, i: (b, h, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, T_pad, hs),
+                lambda b, h, i, _q=q_per_kv: (b, h // _q, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, T_pad, hs),
+                lambda b, h, i, _q=q_per_kv: (b, h // _q, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, hs), lambda b, h, i: (b, h, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, T_pad, hs), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :, :T, :]
