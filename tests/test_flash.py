@@ -39,4 +39,6 @@ def test_fresh_prefill_path_matches_cache_path():
     lb, kv_b = forward(cfg, params, toks, ip, kv=kv_b, fresh_prefill=True)
     # the two paths reduce the softmax in different orders (T×cache vs T×T)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-4, atol=1e-4)
-    np.testing.assert_array_equal(np.asarray(kv_a["k"]), np.asarray(kv_b["k"]))
+    np.testing.assert_allclose(
+        np.asarray(kv_a["k"]), np.asarray(kv_b["k"]), rtol=1e-6, atol=1e-7
+    )
