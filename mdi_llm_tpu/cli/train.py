@@ -114,9 +114,21 @@ def main(argv=None):
             params = None
         trainer = Trainer(cfg, tc, mesh=mesh, params=params, out_dir=out_dir)
 
-    train = data_loader.open_bin(args.dataset / "train.bin")
-    val_p = args.dataset / "val.bin"
-    val = data_loader.open_bin(val_p) if val_p.exists() else None
+    # prefer the native C++ loader when the toolchain is present
+    try:
+        from mdi_llm_tpu.utils import native_loader
+
+        use_native = native_loader.is_available()
+    except Exception:
+        use_native = False
+    train_p, val_p = args.dataset / "train.bin", args.dataset / "val.bin"
+    if use_native:
+        train = native_loader.NativeBinDataset(train_p, seed=args.seed)
+        val = native_loader.NativeBinDataset(val_p, seed=args.seed + 1) if val_p.exists() else None
+        log.info("using native C++ data loader")
+    else:
+        train = data_loader.open_bin(train_p)
+        val = data_loader.open_bin(val_p) if val_p.exists() else None
 
     def log_cb(entry):
         print(json.dumps(entry))

@@ -298,12 +298,20 @@ class Trainer:
         self.iter_num += 1
         return float(loss)
 
-    def estimate_loss(self, data: np.ndarray, rng) -> float:
+    @staticmethod
+    def _sample_batch(data, batch_size, block_size, rng):
+        """Accepts a token array (NumPy path) or any object exposing
+        `.get_batch(batch, block)` (e.g. the native C++ loader)."""
+        if hasattr(data, "get_batch"):
+            return data.get_batch(batch_size, block_size)
+        return data_loader.get_batch(data, batch_size, block_size, rng)
+
+    def estimate_loss(self, data, rng) -> float:
         """Mean loss over eval_iters random batches (≡ reference
         `estimate_loss`)."""
         losses = []
         for _ in range(self.tc.eval_iters):
-            x, y = data_loader.get_batch(data, self.tc.batch_size, self.block_size, rng)
+            x, y = self._sample_batch(data, self.tc.batch_size, self.block_size, rng)
             losses.append(float(self._eval(self.params, jnp.asarray(x), jnp.asarray(y))))
         return float(np.mean(losses))
 
@@ -345,7 +353,7 @@ class Trainer:
             xs = np.empty((tc.grad_acc_steps, tc.batch_size, self.block_size), np.int32)
             ys = np.empty_like(xs)
             for m in range(tc.grad_acc_steps):
-                xs[m], ys[m] = data_loader.get_batch(
+                xs[m], ys[m] = self._sample_batch(
                     train_data, tc.batch_size, self.block_size, rng
                 )
             loss = self.train_step(xs, ys)

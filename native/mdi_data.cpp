@@ -1,0 +1,129 @@
+// Native data-loading runtime for mdi_llm_tpu.
+//
+// TPU-native counterpart of the reference's Python data path
+// (/root/reference/src/sub/utils/data_loader.py:70-126: np.memmap +
+// per-batch Python loop).  This library mmaps the tokenized .bin corpus and
+// gathers random (x, y) next-token training windows directly into
+// caller-provided buffers — no Python-loop per sample, no intermediate
+// copies, deterministic given a seed (splitmix64 → xorshift), so training
+// batches are reproducible across the ctypes and pure-NumPy loaders.
+//
+// Build: make -C native    (produces libmdi_data.so)
+// ABI: plain C, used from Python via ctypes (mdi_llm_tpu/utils/native_loader.py).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct BinFile {
+  void* base = nullptr;
+  size_t bytes = 0;
+  int fd = -1;
+  int dtype_size = 2;  // uint16 tokens by default (vocab < 65536)
+};
+
+inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+inline uint32_t token_at(const BinFile* f, size_t idx) {
+  if (f->dtype_size == 2)
+    return reinterpret_cast<const uint16_t*>(f->base)[idx];
+  return reinterpret_cast<const uint32_t*>(f->base)[idx];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open a token bin file; dtype_size is 2 (uint16) or 4 (uint32).
+// Returns an opaque handle (heap pointer) or null on failure.
+void* mdi_open_bin(const char* path, int dtype_size) {
+  if (dtype_size != 2 && dtype_size != 4) return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  madvise(base, st.st_size, MADV_RANDOM);
+  BinFile* f = new BinFile();
+  f->base = base;
+  f->bytes = static_cast<size_t>(st.st_size);
+  f->fd = fd;
+  f->dtype_size = dtype_size;
+  return f;
+}
+
+// Number of tokens in the file.
+int64_t mdi_num_tokens(void* handle) {
+  auto* f = static_cast<BinFile*>(handle);
+  return f ? static_cast<int64_t>(f->bytes / f->dtype_size) : -1;
+}
+
+// Gather `batch` random windows of `block` tokens: x[i] = data[o..o+block),
+// y[i] = data[o+1..o+block+1).  Outputs are int32 row-major
+// (batch, block).  Deterministic in `seed`.  Returns 0 on success.
+int mdi_sample_batch(void* handle, int64_t batch, int64_t block, uint64_t seed,
+                     int32_t* out_x, int32_t* out_y) {
+  auto* f = static_cast<BinFile*>(handle);
+  if (!f || batch <= 0 || block <= 0) return 1;
+  const int64_t n = mdi_num_tokens(handle);
+  if (n <= block + 1) return 2;
+  uint64_t state = seed ? seed : 0x853c49e6748fea9bULL;
+  const uint64_t span = static_cast<uint64_t>(n - block - 1);
+  for (int64_t b = 0; b < batch; ++b) {
+    const uint64_t off = splitmix64(state) % span;
+    int32_t* xr = out_x + b * block;
+    int32_t* yr = out_y + b * block;
+    if (f->dtype_size == 2) {
+      const uint16_t* src = reinterpret_cast<const uint16_t*>(f->base) + off;
+      for (int64_t t = 0; t < block; ++t) {
+        xr[t] = src[t];
+        yr[t] = src[t + 1];
+      }
+    } else {
+      const uint32_t* src = reinterpret_cast<const uint32_t*>(f->base) + off;
+      for (int64_t t = 0; t < block; ++t) {
+        xr[t] = static_cast<int32_t>(src[t]);
+        yr[t] = static_cast<int32_t>(src[t + 1]);
+      }
+    }
+  }
+  return 0;
+}
+
+// Sequential read of `count` tokens starting at `start` (validation sweeps).
+int mdi_read_tokens(void* handle, int64_t start, int64_t count, int32_t* out) {
+  auto* f = static_cast<BinFile*>(handle);
+  if (!f || start < 0 || count < 0) return 1;
+  const int64_t n = mdi_num_tokens(handle);
+  if (start + count > n) return 2;
+  for (int64_t i = 0; i < count; ++i) out[i] = token_at(f, start + i);
+  return 0;
+}
+
+void mdi_close_bin(void* handle) {
+  auto* f = static_cast<BinFile*>(handle);
+  if (!f) return;
+  munmap(f->base, f->bytes);
+  ::close(f->fd);
+  delete f;
+}
+
+}  // extern "C"
