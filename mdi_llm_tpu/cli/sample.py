@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
     ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument(
+        "--profile-dir", type=Path, default=None,
+        help="capture a jax.profiler device trace here (TensorBoard/Perfetto)",
+    )
     return ap
 
 
@@ -83,29 +87,35 @@ def main(argv=None):
     temperature = 0.0 if args.greedy else args.temperature
     seq_len = args.sequence_length
 
+    from mdi_llm_tpu.utils.profiling import profile
+
+    host_prof = (
+        args.logs_dir / "sample_profile.prof" if args.debug else None
+    )  # ≡ reference sample.py:34-37
     t_load = time.perf_counter()
-    if args.pipeline_stages:
-        from mdi_llm_tpu.parallel.pipeline import PipelineEngine
+    with profile(logdir=args.profile_dir, host_profile_path=host_prof):
+        if args.pipeline_stages:
+            from mdi_llm_tpu.parallel.pipeline import PipelineEngine
 
-        engine = PipelineEngine(
-            cfg, params, n_stages=args.pipeline_stages, max_seq_length=seq_len,
-            rng_seed=args.seed,
-        )
-        n_nodes = args.pipeline_stages
-        outs, stats = engine.generate(
-            prompt_ids, args.n_tokens, temperature=temperature,
-            top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
-        )
-    else:
-        from mdi_llm_tpu.generation import Generator
+            engine = PipelineEngine(
+                cfg, params, n_stages=args.pipeline_stages, max_seq_length=seq_len,
+                rng_seed=args.seed,
+            )
+            n_nodes = args.pipeline_stages
+            outs, stats = engine.generate(
+                prompt_ids, args.n_tokens, temperature=temperature,
+                top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
+            )
+        else:
+            from mdi_llm_tpu.generation import Generator
 
-        engine = Generator(cfg, params, max_seq_length=seq_len, rng_seed=args.seed)
-        n_nodes = 1
-        outs, stats = engine.generate(
-            prompt_ids, args.n_tokens, temperature=temperature,
-            top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
-            chunk_size=args.chunk,
-        )
+            engine = Generator(cfg, params, max_seq_length=seq_len, rng_seed=args.seed)
+            n_nodes = 1
+            outs, stats = engine.generate(
+                prompt_ids, args.n_tokens, temperature=temperature,
+                top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
+                chunk_size=args.chunk,
+            )
     gen_time = time.perf_counter() - t_load
 
     for i, (ids, plen) in enumerate(zip(outs, (len(p) for p in prompt_ids))):

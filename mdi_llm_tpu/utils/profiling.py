@@ -1,0 +1,42 @@
+"""Profiling helpers: XLA device traces + host cProfile.
+
+The reference's only profiler is cProfile behind `--debug`
+(`/root/reference/src/sample.py:34-37,272-276`); here the same flag also
+captures a `jax.profiler` device trace (viewable in TensorBoard /
+Perfetto) — the TPU-native upgrade called out in SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import contextlib
+from pathlib import Path
+from typing import Iterator, Optional, Union
+
+PathLike = Union[str, Path]
+
+
+@contextlib.contextmanager
+def profile(
+    logdir: Optional[PathLike] = None,
+    host_profile_path: Optional[PathLike] = None,
+) -> Iterator[None]:
+    """Capture a jax.profiler trace to `logdir` and/or a cProfile dump."""
+    import jax
+
+    prof = None
+    if host_profile_path is not None:
+        prof = cProfile.Profile()
+        prof.enable()
+    trace_cm = (
+        jax.profiler.trace(str(logdir)) if logdir is not None else contextlib.nullcontext()
+    )
+    try:
+        with trace_cm:
+            yield
+    finally:
+        if prof is not None:
+            prof.disable()
+            p = Path(host_profile_path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            prof.dump_stats(str(p))

@@ -1,0 +1,36 @@
+"""Char and trainable-BPE tokenizers (legacy-generation parity)."""
+
+import numpy as np
+
+from mdi_llm_tpu.utils.simple_tokenizers import BPETokenizer, CharTokenizer
+
+
+def test_char_roundtrip(tmp_path):
+    text = "hello shakespeare!\nact one."
+    tok = CharTokenizer().train(text)
+    ids = tok.encode("hello one")
+    assert tok.decode(ids) == "hello one"
+    assert tok.vocab_size == len(set(text))
+    tok.save(tmp_path / "char.json")
+    tok2 = CharTokenizer.load(tmp_path / "char.json")
+    np.testing.assert_array_equal(tok2.encode("hello"), tok.encode("hello"))
+
+
+def test_bpe_train_and_roundtrip(tmp_path):
+    text = "the quick brown fox jumps over the lazy dog " * 50
+    tok = BPETokenizer().train(text, vocab_size=300)
+    assert 256 < tok.vocab_size <= 300
+    ids = tok.encode("the quick brown fox")
+    assert tok.decode(ids) == "the quick brown fox"
+    # merges compress: fewer tokens than bytes
+    assert len(ids) < len("the quick brown fox".encode())
+    tok.save(tmp_path / "bpe.json")
+    tok2 = BPETokenizer.load(tmp_path / "bpe.json")
+    np.testing.assert_array_equal(tok2.encode("lazy dog"), tok.encode("lazy dog"))
+    assert tok2.decode(tok2.encode("héllo wörld")) == "héllo wörld"
+
+
+def test_bpe_handles_unseen_text():
+    tok = BPETokenizer().train("aaaa bbbb aaaa bbbb", vocab_size=260)
+    out = tok.decode(tok.encode("zzz unseen ©"))
+    assert out == "zzz unseen ©"
