@@ -269,6 +269,79 @@ class Config:
                 ),
                 tie_embeddings=True,
             )
+        elif mt == "falcon":
+            multi_query = hf.get("multi_query", True)
+            new_arch = hf.get("new_decoder_architecture", False)
+            if new_arch:
+                groups = hf.get("num_kv_heads", hf["num_attention_heads"])
+            elif multi_query:
+                groups = 1
+            else:
+                groups = hf["num_attention_heads"]
+            data = dict(
+                name=hf.get("_name_or_path", "falcon"),
+                block_size=hf.get("max_position_embeddings", 2048),
+                vocab_size=hf["vocab_size"],
+                padded_vocab_size=hf["vocab_size"],
+                n_layer=hf["num_hidden_layers"],
+                n_head=hf["num_attention_heads"],
+                n_embd=hf["hidden_size"],
+                n_query_groups=groups,
+                rotary_percentage=1.0,
+                parallel_residual=hf.get("parallel_attn", True),
+                bias=hf.get("bias", False),
+                shared_attention_norm=not new_arch,
+                norm_class_name="LayerNorm",
+                norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+                mlp_class_name="GptNeoxMLP",
+                rope_base=int(hf.get("rope_theta", 10000)),
+                tie_embeddings=hf.get("tie_word_embeddings", False),
+            )
+        elif mt == "phi":
+            data = dict(
+                name=hf.get("_name_or_path", "phi"),
+                block_size=hf.get("max_position_embeddings", 2048),
+                vocab_size=hf["vocab_size"],
+                padded_vocab_size=hf["vocab_size"],
+                n_layer=hf["num_hidden_layers"],
+                n_head=hf["num_attention_heads"],
+                n_embd=hf["hidden_size"],
+                rotary_percentage=hf.get("partial_rotary_factor", 0.5),
+                parallel_residual=True,
+                shared_attention_norm=True,
+                bias=True,
+                lm_head_bias=True,
+                norm_class_name="LayerNorm",
+                norm_eps=hf.get("layer_norm_eps", 1e-5),
+                mlp_class_name="GptNeoxMLP",
+                gelu_approximate="tanh",
+                intermediate_size=hf.get("intermediate_size"),
+                rope_base=int(hf.get("rope_theta", 10000)),
+            )
+        elif mt == "gemma":
+            data = dict(
+                name=hf.get("_name_or_path", "gemma"),
+                block_size=hf.get("max_position_embeddings", 8192),
+                vocab_size=hf["vocab_size"],
+                padded_vocab_size=hf["vocab_size"],
+                n_layer=hf["num_hidden_layers"],
+                n_head=hf["num_attention_heads"],
+                n_embd=hf["hidden_size"],
+                n_query_groups=hf.get("num_key_value_heads", 1),
+                head_size=hf.get("head_dim"),
+                rotary_percentage=1.0,
+                parallel_residual=False,
+                bias=False,
+                norm_class_name="RMSNorm",
+                norm_eps=hf.get("rms_norm_eps", 1e-6),
+                mlp_class_name="GemmaMLP",
+                gelu_approximate="tanh",
+                intermediate_size=hf["intermediate_size"],
+                rope_base=int(hf.get("rope_theta", 10000)),
+                scale_embeddings=True,
+                tie_embeddings=True,
+                rmsnorm_add_unit_offset=True,
+            )
         elif mt == "gpt_neox":
             data = dict(
                 name=hf.get("_name_or_path", "gpt_neox"),

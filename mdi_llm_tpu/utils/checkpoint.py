@@ -166,12 +166,16 @@ def convert_hf_checkpoint(
         mt = _model_type_for(cfg)
 
     raw: Dict[str, np.ndarray] = dict(iter_hf_tensors(ckpt_dir))
-    if mt in ("llama", "mistral", "mixtral"):
+    if mt in ("llama", "mistral", "mixtral", "gemma"):
         params = _map_llama(cfg, raw)
     elif mt == "gpt2":
         params = _map_gpt2(cfg, raw)
     elif mt == "gpt_neox":
         params = _map_neox(cfg, raw)
+    elif mt == "falcon":
+        params = _map_falcon(cfg, raw)
+    elif mt == "phi":
+        params = _map_phi(cfg, raw)
     else:
         raise ValueError(f"unsupported model_type {mt!r} for conversion")
     del raw
@@ -361,6 +365,110 @@ def _map_neox(cfg: Config, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
             "bias": raw["gpt_neox.final_layer_norm.bias"],
         },
         "lm_head": {"weight": _pad_vocab(raw["embed_out.weight"], cfg.padded_vocab_size)},
+    }
+
+
+def _map_falcon(cfg: Config, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF falcon naming → pytree (≡ `copy_weights_falcon`,
+    convert_hf_checkpoint.py:61-107).  Falcon's fused query_key_value is
+    already the per-group [q…, k, v] interleave.  Covers the 7b layout
+    (parallel attention, shared input_layernorm); the 40b two-norm
+    `new_decoder_architecture` is not wired yet."""
+    if not cfg.shared_attention_norm:
+        raise NotImplementedError("falcon new_decoder_architecture layout")
+    L = cfg.n_layer
+    layers = []
+    for i in range(L):
+        pre = f"transformer.h.{i}."
+        layers.append(
+            {
+                "norm_1": {
+                    "weight": raw[pre + "input_layernorm.weight"],
+                    "bias": raw[pre + "input_layernorm.bias"],
+                },
+                "attn": {
+                    "qkv": {"weight": raw[pre + "self_attention.query_key_value.weight"]},
+                    "proj": {"weight": raw[pre + "self_attention.dense.weight"]},
+                },
+                "mlp": {
+                    "fc": {"weight": raw[pre + "mlp.dense_h_to_4h.weight"]},
+                    "proj": {"weight": raw[pre + "mlp.dense_4h_to_h.weight"]},
+                },
+            }
+        )
+    return {
+        "wte": {
+            "weight": _pad_vocab(
+                raw["transformer.word_embeddings.weight"], cfg.padded_vocab_size
+            )
+        },
+        "blocks": _stack(layers),
+        "ln_f": {
+            "weight": raw["transformer.ln_f.weight"],
+            "bias": raw["transformer.ln_f.bias"],
+        },
+        "lm_head": {"weight": _pad_vocab(raw["lm_head.weight"], cfg.padded_vocab_size)},
+    }
+
+
+def _map_phi(cfg: Config, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF phi naming → pytree (≡ `copy_weights_phi`,
+    convert_hf_checkpoint.py:201-272): separate q/k/v with biases fused into
+    the interleaved layout, shared input_layernorm, biased LM head."""
+    L = cfg.n_layer
+    layers = []
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        qkv_w = fuse_qkv(
+            cfg,
+            raw[pre + "self_attn.q_proj.weight"],
+            raw[pre + "self_attn.k_proj.weight"],
+            raw[pre + "self_attn.v_proj.weight"],
+        )
+        qkv_b = _fuse_qkv_bias(
+            cfg,
+            raw[pre + "self_attn.q_proj.bias"],
+            raw[pre + "self_attn.k_proj.bias"],
+            raw[pre + "self_attn.v_proj.bias"],
+        )
+        layers.append(
+            {
+                "norm_1": {
+                    "weight": raw[pre + "input_layernorm.weight"],
+                    "bias": raw[pre + "input_layernorm.bias"],
+                },
+                "attn": {
+                    "qkv": {"weight": qkv_w, "bias": qkv_b},
+                    "proj": {
+                        "weight": raw[pre + "self_attn.dense.weight"],
+                        "bias": raw[pre + "self_attn.dense.bias"],
+                    },
+                },
+                "mlp": {
+                    "fc": {
+                        "weight": raw[pre + "mlp.fc1.weight"],
+                        "bias": raw[pre + "mlp.fc1.bias"],
+                    },
+                    "proj": {
+                        "weight": raw[pre + "mlp.fc2.weight"],
+                        "bias": raw[pre + "mlp.fc2.bias"],
+                    },
+                },
+            }
+        )
+    return {
+        "wte": {
+            "weight": _pad_vocab(raw["model.embed_tokens.weight"], cfg.padded_vocab_size)
+        },
+        "blocks": _stack(layers),
+        "ln_f": {
+            "weight": raw["model.final_layernorm.weight"],
+            "bias": raw["model.final_layernorm.bias"],
+        },
+        "lm_head": {
+            "weight": _pad_vocab(raw["lm_head.weight"], cfg.padded_vocab_size),
+            "bias": _pad_vocab(raw["lm_head.bias"], cfg.padded_vocab_size),
+        },
     }
 
 

@@ -128,6 +128,121 @@ def test_hf_neox_logit_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=3e-4, atol=3e-4)
 
 
+def test_hf_falcon_logit_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(
+        vocab_size=96,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        new_decoder_architecture=False,
+        multi_query=True,
+        parallel_attn=True,
+        bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    model = FalconForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    assert cfg.n_query_groups == 1 and cfg.shared_attention_norm
+
+    toks = np.array([[4, 7, 2, 90, 31, 8]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=3e-4, atol=3e-4)
+
+
+def test_hf_phi_logit_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import PhiConfig, PhiForCausalLM
+
+    hf_cfg = PhiConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        partial_rotary_factor=0.5,
+        max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(5)
+    model = PhiForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    assert cfg.shared_attention_norm and cfg.lm_head_bias and cfg.rotary_percentage == 0.5
+
+    toks = np.array([[4, 7, 2, 90, 31]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=3e-4, atol=3e-4)
+
+
+def test_hf_gemma_logit_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=1,
+        head_dim=8,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(6)
+    model = GemmaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    assert cfg.tie_embeddings and cfg.scale_embeddings and cfg.rmsnorm_add_unit_offset
+
+    toks = np.array([[4, 7, 2, 90, 31]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=5e-4, atol=5e-4)
+
+
+def test_hf_mixtral_moe_logit_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = MixtralForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    assert cfg.n_expert == 4 and cfg.n_expert_per_token == 2
+
+    toks = np.array([[4, 7, 2, 90, 31, 11]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=3e-4, atol=3e-4)
+
+
 def test_reverse_conversion_roundtrip(tmp_path):
     """convert_to_hf_state_dict must invert the fused layout exactly."""
     torch = pytest.importorskip("torch")
