@@ -689,7 +689,8 @@ for size, (L, D, H) in {
         dict(
             name=f"pythia-{size}{{}}",
             hf_config=dict(org="EleutherAI", name=f"pythia-{size}{{}}"),
-            block_size=2048,
+            # 14m/31m were trained at shorter context (HF config.json)
+            block_size={"14m": 512, "31m": 1024}.get(size, 2048),
             vocab_size=50254,
             padding_multiple=128,
             n_layer=L,
@@ -728,6 +729,8 @@ for size, (L, D, H) in {"3b": (32, 2560, 32), "7b": (32, 4096, 32), "12b": (36, 
 for nm, (L, D, H) in {
     "RedPajama-INCITE-{}-3B-v1": (32, 2560, 32),
     "RedPajama-INCITE-7B-{}": (32, 4096, 32),
+    # early v0.1 naming of the 7B release (reference config.py:454-463)
+    "RedPajama-INCITE-{}-7B-v0.1": (32, 4096, 32),
 }.items():
     _add(
         dict(
@@ -852,6 +855,47 @@ for nm in ("stablelm-3b-4e1t", "stablelm-zephyr-3b"):
         )
     )
 
+# ---- StableCode (gpt-neox arch; reference config.py:240-280) --------------
+for nm, bs in {
+    "stablecode-completion-alpha-3b": 16384,
+    "stablecode-completion-alpha-3b-4k": 4096,
+    "stablecode-instruct-alpha-3b": 4096,
+}.items():
+    _add(
+        dict(
+            name=nm,
+            hf_config=dict(org="stabilityai", name=nm),
+            block_size=bs,
+            vocab_size=49152,
+            n_layer=32,
+            n_head=32,
+            n_embd=2560,
+            rotary_percentage=0.25,
+            parallel_residual=True,
+            bias=True,
+            norm_class_name="LayerNorm",
+            mlp_class_name="GptNeoxMLP",
+        )
+    )
+_add(
+    dict(
+        name="stable-code-3b",
+        hf_config=dict(org="stabilityai", name="stable-code-3b"),
+        block_size=16384,
+        vocab_size=50254,
+        padded_vocab_size=50304,
+        n_layer=32,
+        n_head=32,
+        n_embd=2560,
+        rotary_percentage=0.25,
+        parallel_residual=False,
+        bias=False,
+        norm_class_name="LayerNorm",
+        mlp_class_name="LLaMAMLP",
+        intermediate_size=6912,
+    )
+)
+
 # ---- OpenLLaMA / Vicuna / LongChat / Nous-Hermes / Platypus ---------------
 for nm, (org, (L, D, H, I, bs)) in {
     "open_llama_3b": ("openlm-research", (26, 3200, 32, 8640, 2048)),
@@ -873,6 +917,10 @@ for nm, (org, (L, D, H, I, bs)) in {
     "Platypus2-7B": ("garage-bAInd", (32, 4096, 32, 11008, 4096)),
     "Platypus2-13B": ("garage-bAInd", (40, 5120, 40, 13824, 4096)),
     "Platypus2-70B": ("garage-bAInd", (80, 8192, 64, 28672, 4096)),
+    "Platypus2-70B-instruct": ("garage-bAInd", (80, 8192, 64, 28672, 4096)),
+    "Camel-Platypus2-13B": ("garage-bAInd", (40, 5120, 40, 13824, 4096)),
+    "Camel-Platypus2-70B": ("garage-bAInd", (80, 8192, 64, 28672, 4096)),
+    "Stable-Platypus2-13B": ("garage-bAInd", (40, 5120, 40, 13824, 4096)),
     "FreeWilly2": ("stabilityai", (80, 8192, 64, 28672, 4096)),
     "LLaMA-2-7B-32K": ("togethercomputer", (32, 4096, 32, 11008, 32768)),
 }.items():
@@ -890,6 +938,9 @@ for nm, (org, (L, D, H, I, bs)) in {
             n_query_groups=groups,
             norm_eps=1e-6 if "open_llama" in nm else 1e-5,
             intermediate_size=I,
+            # LLaMA-2-7B-32K extends 4k->32k via positional interpolation
+            # (reference config.py:1445: rope_condense_ratio=8)
+            **(dict(rope_condense_ratio=8) if nm == "LLaMA-2-7B-32K" else {}),
             **_llama,
         )
     )

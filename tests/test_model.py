@@ -220,6 +220,76 @@ def test_registry_basics():
     assert 2.5e8 < n.estimate_params() < 3.5e8
 
 
+# Every named model the reference registry exposes (src/sub/config.py:175-1669,
+# name_to_config keys incl. expanded {} templates).  Full-surface parity: a
+# reference user must be able to `Config.from_name` any of these.
+REFERENCE_REGISTRY_NAMES = [
+    "Camel-Platypus2-13B", "Camel-Platypus2-70B", "CodeGemma-7b-it",
+    "CodeLlama-13b-Instruct-hf", "CodeLlama-13b-Python-hf", "CodeLlama-13b-hf",
+    "CodeLlama-34b-Instruct-hf", "CodeLlama-34b-Python-hf", "CodeLlama-34b-hf",
+    "CodeLlama-70b-Instruct-hf", "CodeLlama-70b-Python-hf", "CodeLlama-70b-hf",
+    "CodeLlama-7b-Instruct-hf", "CodeLlama-7b-Python-hf", "CodeLlama-7b-hf",
+    "Danube2-1.8b-chat", "FreeWilly2", "Gemma-2b", "Gemma-2b-it", "Gemma-7b",
+    "Gemma-7b-it", "LLaMA-2-7B-32K", "Llama-2-13b-chat-hf", "Llama-2-13b-hf",
+    "Llama-2-70b-chat-hf", "Llama-2-70b-hf", "Llama-2-7b-chat-hf",
+    "Llama-2-7b-chat-hf-function-calling-v2", "Llama-2-7b-hf", "Llama-3-70B",
+    "Llama-3-70B-Instruct", "Llama-3-8B", "Llama-3-8B-Instruct",
+    "Mistral-7B-Instruct-v0.1", "Mistral-7B-Instruct-v0.2",
+    "Mistral-7B-Instruct-v0.3", "Mistral-7B-v0.1", "Mistral-7B-v0.2",
+    "Mistral-7B-v0.3", "Mixtral-8x7B-Instruct-v0.1", "Mixtral-8x7B-v0.1",
+    "Nous-Hermes-13b", "Nous-Hermes-Llama2-13b", "Nous-Hermes-llama-2-7b",
+    "Platypus-30B", "Platypus2-13B", "Platypus2-70B", "Platypus2-70B-instruct",
+    "Platypus2-7B", "RedPajama-INCITE-7B-Base", "RedPajama-INCITE-7B-Chat",
+    "RedPajama-INCITE-7B-Instruct", "RedPajama-INCITE-Base-3B-v1",
+    "RedPajama-INCITE-Base-7B-v0.1", "RedPajama-INCITE-Chat-3B-v1",
+    "RedPajama-INCITE-Chat-7B-v0.1", "RedPajama-INCITE-Instruct-3B-v1",
+    "RedPajama-INCITE-Instruct-7B-v0.1", "Stable-Platypus2-13B", "dolly-v2-12b",
+    "dolly-v2-3b", "dolly-v2-7b", "falcon-180B", "falcon-180B-chat",
+    "falcon-40b", "falcon-40b-instruct", "falcon-7b", "falcon-7b-instruct",
+    "longchat-13b-16k", "longchat-7b-16k", "open_llama_13b", "open_llama_3b",
+    "open_llama_7b", "phi-1_5", "phi-2", "pythia-1.4b", "pythia-1.4b-deduped",
+    "pythia-12b", "pythia-12b-deduped", "pythia-14m", "pythia-160m",
+    "pythia-160m-deduped", "pythia-1b", "pythia-1b-deduped", "pythia-2.8b",
+    "pythia-2.8b-deduped", "pythia-31m", "pythia-410m", "pythia-410m-deduped",
+    "pythia-6.9b", "pythia-6.9b-deduped", "pythia-70m", "pythia-70m-deduped",
+    "stable-code-3b", "stablecode-completion-alpha-3b",
+    "stablecode-completion-alpha-3b-4k", "stablecode-instruct-alpha-3b",
+    "stablelm-3b-4e1t", "stablelm-base-alpha-3b", "stablelm-base-alpha-7b",
+    "stablelm-tuned-alpha-3b", "stablelm-tuned-alpha-7b", "stablelm-zephyr-3b",
+    "tiny-llama-1.1b", "tiny-llama-1.1b-chat", "vicuna-13b-v1.3",
+    "vicuna-13b-v1.5", "vicuna-13b-v1.5-16k", "vicuna-33b-v1.3",
+    "vicuna-7b-v1.3", "vicuna-7b-v1.5", "vicuna-7b-v1.5-16k",
+]
+
+
+def test_registry_covers_every_reference_model():
+    missing = []
+    for name in REFERENCE_REGISTRY_NAMES:
+        try:
+            cfg = Config.from_name(name)
+        except Exception:
+            missing.append(name)
+            continue
+        assert cfg.n_layer > 0 and cfg.padded_vocab_size % 2 == 0
+    assert not missing, f"unresolvable reference model names: {missing}"
+
+
+def test_registry_spot_facts():
+    assert Config.from_name("pythia-14m").block_size == 512
+    assert Config.from_name("pythia-31m").block_size == 1024
+    k32 = Config.from_name("LLaMA-2-7B-32K")
+    assert k32.rope_condense_ratio == 8 and k32.block_size == 32768
+    sc = Config.from_name("stable-code-3b")
+    assert sc.mlp_class_name == "LLaMAMLP" and sc.padded_vocab_size == 50304
+    mx = Config.from_name("Mixtral-8x7B-v0.1")
+    assert mx.n_expert == 8 and mx.n_expert_per_token == 2
+    # deliberate divergences from reference-registry quirks, matching the
+    # actual HF checkpoints instead:
+    assert Config.from_name("Platypus2-70B").n_query_groups == 8  # GQA, not MHA
+    assert Config.from_name("Gemma-7b").block_size == 8192
+    assert Config.from_name("CodeLlama-13b-Instruct-hf").block_size == 16384
+
+
 def test_config_yaml_roundtrip(tmp_path):
     cfg = Config.from_name("tiny-llama-1.1b")
     cfg.save(tmp_path)
