@@ -43,6 +43,23 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--debug", action="store_true")
 
 
+def add_run_args(ap: argparse.ArgumentParser) -> None:
+    """Generation-run flags shared by cli/sample.py, cli/starter.py and
+    cli/secondary.py (≡ reference starter.py/sample.py flag set)."""
+    from mdi_llm_tpu.config import TEMPERATURE, TOP_K
+
+    ap.add_argument("--n-samples", type=int, default=1)
+    ap.add_argument("--n-tokens", type=int, default=300, help="tokens per sample")
+    ap.add_argument("--prompt", default="Once upon a time,", help='text or "FILE:<path>"')
+    ap.add_argument("--temperature", type=float, default=TEMPERATURE)
+    ap.add_argument("--top-k", type=int, default=TOP_K)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--greedy", action="store_true", help="temperature 0 (parity mode)")
+    ap.add_argument("--plots", action="store_true")
+    ap.add_argument("--time-run", type=Path, default=None, help="append run stats CSV")
+    ap.add_argument("--logs-dir", type=Path, default=Path("logs"))
+
+
 def setup_logging(args) -> logging.Logger:
     level = (
         logging.DEBUG if args.debug else logging.INFO if args.verbose else logging.WARNING

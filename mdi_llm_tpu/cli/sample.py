@@ -25,11 +25,11 @@ import numpy as np
 
 from mdi_llm_tpu.cli._common import (
     add_common_args,
+    add_run_args,
     load_model,
     select_device,
     setup_logging,
 )
-from mdi_llm_tpu.config import TEMPERATURE, TOP_K
 from mdi_llm_tpu.utils import plots
 from mdi_llm_tpu.utils.prompts import get_user_prompt
 
@@ -37,18 +37,9 @@ from mdi_llm_tpu.utils.prompts import get_user_prompt
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__)
     add_common_args(ap)
-    ap.add_argument("--n-samples", type=int, default=1)
-    ap.add_argument("--n-tokens", type=int, default=300, help="tokens per sample")
-    ap.add_argument("--prompt", default="Once upon a time,", help='text or "FILE:<path>"')
-    ap.add_argument("--temperature", type=float, default=TEMPERATURE)
-    ap.add_argument("--top-k", type=int, default=TOP_K)
-    ap.add_argument("--top-p", type=float, default=None)
+    add_run_args(ap)
     ap.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
-    ap.add_argument("--greedy", action="store_true", help="temperature 0 (parity mode)")
     ap.add_argument("--pipeline-stages", type=int, default=0)
-    ap.add_argument("--plots", action="store_true")
-    ap.add_argument("--time-run", type=Path, default=None, help="append run stats CSV")
-    ap.add_argument("--logs-dir", type=Path, default=Path("logs"))
     # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
     ap.add_argument("--process-id", type=int, default=None)

@@ -1,0 +1,60 @@
+"""Secondary-node entry point for multi-process pipeline generation.
+
+Reference-parity CLI (`/root/reference/src/secondary.py`, which takes
+`--nodes-config CONFIG IDX` and blocks in `GPTDistributed.start()` waiting
+for the starter's HTTP `/init`).  TPU-native semantics: the secondary joins
+the `jax.distributed` job as process IDX+1, receives the run spec over the
+device fabric (parallel/nodes.py:broadcast_run_spec — the analog of the
+pickled `/init`+inference messages), and executes the same SPMD ring program
+as the starter; its chips host the middle/last pipeline stages.
+
+Weights: loaded from (shared) storage via --ckpt / --model rather than
+shipped through a Python control plane (see parallel/nodes.py docstring).
+
+Example:
+    python -m mdi_llm_tpu.cli.secondary --ckpt <dir> --nodes-config cfg.json 0
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+from mdi_llm_tpu.cli._common import add_common_args
+from mdi_llm_tpu.cli.starter import add_run_args, run_node
+from mdi_llm_tpu.parallel.nodes import parse_nodes_config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_common_args(ap)
+    add_run_args(ap)
+    # ≡ reference secondary.py:76-84: one flag, two values (config path, index)
+    ap.add_argument(
+        "--nodes-config",
+        nargs=2,
+        metavar=("CONFIG", "IDX"),
+        required=True,
+        help="topology JSON and this node's secondary index (0-based)",
+    )
+    # accepted for launch-script symmetry with cli/starter.py; the effective
+    # value always comes from the starter's broadcast run spec
+    ap.add_argument("--pipeline-stages", type=int, default=None)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    config_path, idx = Path(args.nodes_config[0]), int(args.nodes_config[1])
+    nodes_cfg = parse_nodes_config(config_path)
+    if not 0 <= idx < len(nodes_cfg.secondary):
+        raise SystemExit(
+            f"secondary index {idx} out of range (config lists "
+            f"{len(nodes_cfg.secondary)} secondaries)"
+        )
+    args.nodes_config = config_path
+    run_node(args, nodes_cfg, process_id=idx + 1)
+
+
+if __name__ == "__main__":
+    main()

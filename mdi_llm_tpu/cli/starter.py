@@ -1,0 +1,183 @@
+"""Starter-node entry point for multi-process pipeline generation.
+
+Reference-parity CLI (`/root/reference/src/starter.py`): reads a nodes-config
+topology file, brings the node group up, runs recurrent-pipeline generation,
+prints the samples and writes tokens/time CSVs + plots + run-stats CSV with
+the reference's file naming.
+
+TPU-native semantics: instead of POSTing pickled init messages to CherryPy
+servers on each secondary (`model_dist.py:402-497`), the starter is process 0
+of a `jax.distributed` job; secondaries join with `cli/secondary.py` and the
+whole group executes one SPMD ring program (parallel/pipeline.py) whose
+stage-to-stage hop is `jax.lax.ppermute` over ICI/DCN.  Run parameters ship
+starter→secondaries via a device broadcast (parallel/nodes.py).
+
+Examples:
+    # 1 host, all local chips (standalone.json analog — no secondaries):
+    python -m mdi_llm_tpu.cli.starter --ckpt <dir> --nodes-config standalone.json
+
+    # 3-node job (run cli/secondary.py on the other two hosts):
+    python -m mdi_llm_tpu.cli.starter --ckpt <dir> --nodes-config cfg.json \
+        --n-samples 3 --n-tokens 200 --plots --time-run stats.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from mdi_llm_tpu.cli._common import (
+    add_common_args,
+    add_run_args,
+    load_model,
+    select_device,
+    setup_logging,
+)
+from mdi_llm_tpu.parallel.nodes import (
+    NodesConfig,
+    broadcast_run_spec,
+    init_distributed,
+    parse_nodes_config,
+)
+from mdi_llm_tpu.utils import plots
+from mdi_llm_tpu.utils.prompts import get_user_prompt
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_common_args(ap)
+    add_run_args(ap)
+    ap.add_argument(
+        "--nodes-config",
+        type=Path,
+        required=True,
+        help="topology JSON (reference settings_distr schema or mesh schema)",
+    )
+    ap.add_argument(
+        "--pipeline-stages",
+        type=int,
+        default=None,
+        help="stages to split over (default: one per chip in the job)",
+    )
+    return ap
+
+
+def run_node(args, nodes_cfg: NodesConfig, process_id: int):
+    """Shared starter/secondary body: join the job, load the model, receive
+    (or originate) the run spec, and execute the SPMD pipeline ring."""
+    log = setup_logging(args)
+    # device priority: CLI > node JSON > auto (≡ gptserver.py:601-617)
+    node = nodes_cfg.starter if process_id == 0 else nodes_cfg.secondary[process_id - 1]
+    if not args.device and node.device:
+        args.device = node.device
+    select_device(args)
+    init_distributed(nodes_cfg, process_id)
+    is_starter = process_id == 0
+
+    cfg, params, tokenizer, prompt_style = load_model(args, need_tokenizer=is_starter)
+
+    if is_starter:
+        raw_prompts = get_user_prompt(args.prompt, args.n_samples)
+        if tokenizer is not None:
+            styled = [prompt_style.apply(p) for p in raw_prompts]
+            prompt_ids = [tokenizer.encode(p).tolist() for p in styled]
+            stop_seqs = tuple(prompt_style.stop_tokens(tokenizer))
+        else:
+            rng = np.random.default_rng(args.seed)
+            prompt_ids = [
+                rng.integers(1, cfg.vocab_size, 8).tolist() for _ in raw_prompts
+            ]
+            stop_seqs = ()
+        spec = dict(
+            prompt_ids=prompt_ids,
+            n_tokens=args.n_tokens,
+            temperature=0.0 if args.greedy else args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            stop_seqs=stop_seqs,
+            seed=args.seed,
+            seq_len=args.sequence_length,
+            # shape-critical: every process must build the identical SPMD ring
+            n_stages=(
+                args.pipeline_stages
+                or nodes_cfg.pipeline_stages
+                or jax.device_count()
+            ),
+        )
+    else:
+        spec = None
+    spec = broadcast_run_spec(spec)
+
+    from mdi_llm_tpu.parallel.pipeline import PipelineEngine
+
+    n_stages = spec["n_stages"]
+    engine = PipelineEngine(
+        cfg,
+        params,
+        n_stages=n_stages,
+        max_seq_length=spec["seq_len"],
+        rng_seed=spec["seed"],
+    )
+    t0 = time.perf_counter()
+    outs, stats = engine.generate(
+        spec["prompt_ids"],
+        spec["n_tokens"],
+        temperature=spec["temperature"],
+        top_k=spec["top_k"],
+        top_p=spec["top_p"],
+        stop_sequences=spec["stop_seqs"],
+    )
+    gen_time = time.perf_counter() - t0
+
+    if not is_starter:
+        log.info("secondary %d done (%d tokens)", process_id, stats.tokens_generated)
+        return outs, stats, gen_time, engine
+
+    for i, (ids, plen) in enumerate(zip(outs, (len(p) for p in spec["prompt_ids"]))):
+        print(f"--- sample {i} ({len(ids) - plen} new tokens) " + "-" * 30)
+        if tokenizer is not None:
+            print(tokenizer.decode(np.asarray(ids)))
+        else:
+            print(ids)
+    print(
+        f"[{nodes_cfg.n_nodes} node(s) / {n_stages} stage(s)] "
+        f"{stats.tokens_generated} tokens in {gen_time:.2f}s — "
+        f"{stats.tokens_per_s:.2f} tok/s decode (prefill {stats.prefill_s:.2f}s)",
+        file=sys.stderr,
+    )
+    if args.plots or args.time_run:
+        csv_path = plots.tok_time_csv_path(
+            args.logs_dir, nodes_cfg.n_nodes, cfg.name, args.n_samples
+        )
+        plots.write_tok_time_csv(csv_path, stats.tok_time)
+        if args.plots:
+            plots.plot_tokens_per_time(
+                stats.tok_time,
+                csv_path.with_suffix(".png"),
+                label=f"{cfg.name} {nodes_cfg.n_nodes} node(s)",
+            )
+        if args.time_run:
+            plots.append_run_stats(
+                args.time_run,
+                args.n_samples,
+                cfg.n_layer,
+                spec["seq_len"] or cfg.block_size,
+                gen_time,
+            )
+    return outs, stats, gen_time, engine
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    nodes_cfg = parse_nodes_config(args.nodes_config)
+    outs, _, _, _ = run_node(args, nodes_cfg, process_id=0)
+    return outs
+
+
+if __name__ == "__main__":
+    main()

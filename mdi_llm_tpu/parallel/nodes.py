@@ -1,0 +1,141 @@
+"""Node-topology configs and multi-process bootstrap.
+
+TPU-native replacement for the reference's node orchestration
+(`/root/reference/src/sub/model_dist.py:124-573`): where the reference wires
+starter/secondary processes together with a CherryPy HTTP control plane
+(`POST /init` carrying a pickled model config + optional weights,
+`PUT /stop`) and hand-rolled TCP sockets for activations, here every node is
+a `jax.distributed` process contributing its chips to one global mesh, and
+activations move as `ppermute` collectives inside the jitted ring
+(parallel/pipeline.py).  The HTTP init/stop lifecycle collapses into
+`jax.distributed.initialize` + normal process exit.
+
+Two config schemas are accepted (`parse_nodes_config`):
+
+- The reference's `settings_distr/*.json` schema
+  (`nodes.starter{addr, communication.port, inference.{port_in,port_out}}`,
+  `nodes.secondary[i]{...}` — see SURVEY.md §2.1 "Node configs"): the
+  starter's address + communication port become the jax.distributed
+  coordinator; inference ports are accepted and ignored (there is no
+  host-level data plane to bind).
+- A TPU-native schema: `{"coordinator": "host:port", "num_processes": N,
+  "pipeline_stages": S}` (examples/mesh_configs/).
+
+Weights: the reference optionally ships pickled parameter chunks inside the
+HTTP init message (`model_dist.py:402-484`).  Here every process loads the
+checkpoint from (shared) storage itself — on TPU pods checkpoints live on
+NFS/GCS, and shipping weights through a Python control plane would serialize
+through one host's RAM.  Run parameters (prompt tokens, sample counts,
+temperature, ...) ARE shipped starter→secondary, as the reference does, via
+`broadcast_run_spec` (a device all-gather of a pickled spec buffer — the
+analog of the reference's pickled init/inference messages).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+# Max pickled run-spec size shipped starter->secondaries.  The reference's
+# wire protocol caps message size with MSGLENGTH (config.py:100-101); this is
+# our analog.  1 MiB ≈ 250k prompt tokens, far above any realistic prompt.
+RUN_SPEC_BYTES = 1 << 20
+
+
+@dataclass
+class NodeInfo:
+    addr: str
+    comm_port: int
+    device: Optional[str] = None  # per-node platform override (≡ node JSON "device")
+
+
+@dataclass
+class NodesConfig:
+    starter: NodeInfo
+    secondary: List[NodeInfo] = field(default_factory=list)
+    pipeline_stages: Optional[int] = None  # None → one stage per chip
+
+    @property
+    def n_nodes(self) -> int:
+        return 1 + len(self.secondary)
+
+    @property
+    def coordinator(self) -> str:
+        return f"{self.starter.addr}:{self.starter.comm_port}"
+
+
+def _node_from_ref(d: dict, default_port: int) -> NodeInfo:
+    comm = d.get("communication", {}) or {}
+    return NodeInfo(
+        addr=d.get("addr", "127.0.0.1"),
+        comm_port=int(comm.get("port", default_port)),
+        device=d.get("device"),
+    )
+
+
+def parse_nodes_config(path) -> NodesConfig:
+    """Parse either the reference `settings_distr` schema or the TPU-native
+    mesh schema into a NodesConfig."""
+    raw = json.loads(Path(path).read_text())
+    if "nodes" in raw:  # reference schema
+        nodes = raw["nodes"]
+        starter = _node_from_ref(nodes.get("starter", {}), default_port=8088)
+        secondary = [
+            _node_from_ref(s, default_port=8089 + i)
+            for i, s in enumerate(nodes.get("secondary", []) or [])
+        ]
+        return NodesConfig(starter=starter, secondary=secondary)
+    # TPU-native schema
+    coord = raw.get("coordinator", "127.0.0.1:8476")
+    addr, _, port = coord.rpartition(":")
+    n_proc = int(raw.get("num_processes", 1))
+    starter = NodeInfo(addr=addr or "127.0.0.1", comm_port=int(port))
+    secondary = [NodeInfo(addr="?", comm_port=0) for _ in range(n_proc - 1)]
+    return NodesConfig(
+        starter=starter,
+        secondary=secondary,
+        pipeline_stages=raw.get("pipeline_stages"),
+    )
+
+
+def init_distributed(cfg: NodesConfig, process_id: int) -> None:
+    """Join the job as process `process_id` (starter=0, secondary i → i+1).
+    No-op for single-node configs (≡ standalone.json, gptserver.py:276-278).
+    """
+    if cfg.n_nodes == 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.n_nodes,
+        process_id=process_id,
+    )
+
+
+def broadcast_run_spec(spec: Optional[dict]) -> dict:
+    """Ship the run spec (prompt token ids + generation knobs) from the
+    starter to every secondary.  Pass the dict on process 0 and None
+    elsewhere.  ≡ the pickled inference-start message of the reference
+    control plane (`gptserver.py:358-394`); pickle is fine for the same
+    reason it was there — all processes belong to one trusted job.
+    """
+    if jax.process_count() == 1:
+        assert spec is not None
+        return spec
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(RUN_SPEC_BYTES, np.uint8)
+    if spec is not None:
+        payload = pickle.dumps(spec)
+        if 4 + len(payload) > RUN_SPEC_BYTES:
+            raise ValueError(f"run spec too large: {len(payload)} bytes")
+        buf[:4] = np.frombuffer(len(payload).to_bytes(4, "little"), np.uint8)
+        buf[4 : 4 + len(payload)] = np.frombuffer(payload, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    n = int.from_bytes(bytes(out[:4]), "little")
+    return pickle.loads(bytes(out[4 : 4 + n]))

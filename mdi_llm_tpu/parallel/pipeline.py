@@ -126,6 +126,10 @@ class PipelineEngine:
         self.rope = tuple(jax.device_put(np.asarray(r), repl_sh) for r in rope)
 
         self.n_slots = S + 1  # one cache slot per ring position + dummy
+        # Multi-node jobs (cli/starter.py + cli/secondary.py): every process
+        # must be able to read the emitted tokens, so the ring all-gathers
+        # them in-computation and outputs them replicated.
+        self.multiprocess = jax.process_count() > 1
         self._prefill_jit: Dict[Tuple, Any] = {}
         self._decode_jit: Dict[Tuple, Any] = {}
 
@@ -263,9 +267,15 @@ class PipelineEngine:
                 body, carry, jnp.arange(n_steps, dtype=jnp.int32)
             )
             kv_out = {"k": carry[0][None], "v": carry[1][None]}
+            if self.multiprocess:
+                emits = jax.tree_util.tree_map(
+                    lambda e: jax.lax.all_gather(e, "pipe", axis=1, tiled=True),
+                    emits,
+                )
             return kv_out, emits
 
         pipe, repl = P("pipe"), P()
+        emit_spec = repl if self.multiprocess else P(None, "pipe")
         sm = jax.shard_map(
             ring,
             mesh=mesh,
@@ -281,8 +291,9 @@ class PipelineEngine:
             ),
             out_specs=(
                 {"k": pipe, "v": pipe},
-                (P(None, "pipe"), P(None, "pipe"), P(None, "pipe")),
+                (emit_spec, emit_spec, emit_spec),
             ),
+            check_vma=not self.multiprocess,
         )
         return jax.jit(sm, donate_argnums=(3, 4))
 
@@ -349,9 +360,15 @@ class PipelineEngine:
                 "pos": carry[4][None],
                 "valid": carry[5][None],
             }
+            if self.multiprocess:
+                emits = jax.tree_util.tree_map(
+                    lambda e: jax.lax.all_gather(e, "pipe", axis=1, tiled=True),
+                    emits,
+                )
             return kv_out, payload_out, emits
 
         pipe, repl = P("pipe"), P()
+        emit_spec = repl if self.multiprocess else P(None, "pipe")
         sm = jax.shard_map(
             ring,
             mesh=mesh,
@@ -367,8 +384,9 @@ class PipelineEngine:
             out_specs=(
                 {"k": pipe, "v": pipe},
                 {"x": pipe, "sid": pipe, "pos": pipe, "valid": pipe},
-                (P(None, "pipe"), P(None, "pipe"), P(None, "pipe")),
+                (emit_spec, emit_spec, emit_spec),
             ),
+            check_vma=not self.multiprocess,
         )
         return jax.jit(sm, donate_argnums=(3, 4))
 

@@ -1,0 +1,136 @@
+"""Node-topology configs and the starter/secondary multi-process CLIs.
+
+The two-process test is the TPU-native analog of the reference's de-facto
+integration harness — localhost loopback node configs running the full
+distributed stack as N processes on one host (SURVEY.md §4,
+`settings_distr/configuration.json`) — with golden-token equality against
+the single-device engine instead of eyeballing.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mdi_llm_tpu.parallel.nodes import parse_nodes_config
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _write(tmp_path, payload):
+    p = tmp_path / "nodes.json"
+    p.write_text(json.dumps(payload))
+    return p
+
+
+def test_parse_reference_schema(tmp_path):
+    p = _write(
+        tmp_path,
+        {
+            "nodes": {
+                "starter": {
+                    "addr": "10.0.0.1",
+                    "communication": {"port": 8088},
+                    "inference": {"port_in": 8090, "port_out": 8091},
+                    "device": "tpu",
+                },
+                "secondary": [
+                    {
+                        "addr": "10.0.0.2",
+                        "communication": {"starter_addr": "10.0.0.1", "port": 8089},
+                        "inference": {"port_in": 8092, "port_out": 8093},
+                    },
+                    {"addr": "10.0.0.3", "communication": {"port": 8090}},
+                ],
+            }
+        },
+    )
+    cfg = parse_nodes_config(p)
+    assert cfg.n_nodes == 3
+    assert cfg.coordinator == "10.0.0.1:8088"
+    assert cfg.starter.device == "tpu"
+    assert cfg.secondary[1].addr == "10.0.0.3"
+
+
+def test_parse_standalone_schema(tmp_path):
+    p = _write(
+        tmp_path,
+        {"nodes": {"starter": {"addr": "127.0.0.1", "communication": {"port": 1}}, "secondary": []}},
+    )
+    cfg = parse_nodes_config(p)
+    assert cfg.n_nodes == 1
+
+
+def test_parse_mesh_schema(tmp_path):
+    p = _write(
+        tmp_path,
+        {"coordinator": "host0:8476", "num_processes": 2, "pipeline_stages": 16},
+    )
+    cfg = parse_nodes_config(p)
+    assert cfg.n_nodes == 2
+    assert cfg.coordinator == "host0:8476"
+    assert cfg.pipeline_stages == 16
+
+
+def _extract_samples(stdout: str):
+    """Pull the printed token-id lists out of starter/sample stdout."""
+    out = []
+    grab = False
+    for line in stdout.splitlines():
+        if line.startswith("--- sample"):
+            grab = True
+            continue
+        if grab and line.startswith("["):
+            out.append([int(x) for x in re.findall(r"-?\d+", line)])
+            grab = False
+    return out
+
+
+MODEL = "pythia-14m"
+COMMON = ["--model", MODEL, "--device", "cpu", "--greedy", "--n-tokens", "8",
+          "--n-samples", "2", "--seed", "10137"]
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_matches_single_device(tmp_path):
+    cfg_path = _write(
+        tmp_path,
+        {
+            "nodes": {
+                "starter": {"addr": "127.0.0.1", "communication": {"port": 19917}},
+                "secondary": [
+                    {"addr": "127.0.0.1", "communication": {"port": 19918}}
+                ],
+            }
+        },
+    )
+    single = subprocess.run(
+        [sys.executable, "-m", "mdi_llm_tpu.cli.sample", *COMMON],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+    )
+    assert single.returncode == 0, single.stderr[-2000:]
+    want = _extract_samples(single.stdout)
+    assert len(want) == 2 and all(len(w) > 8 for w in want)
+
+    sec = subprocess.Popen(
+        [sys.executable, "-m", "mdi_llm_tpu.cli.secondary", *COMMON,
+         "--pipeline-stages", "2", "--nodes-config", str(cfg_path), "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=REPO,
+    )
+    try:
+        sta = subprocess.run(
+            [sys.executable, "-m", "mdi_llm_tpu.cli.starter", *COMMON,
+             "--pipeline-stages", "2", "--nodes-config", str(cfg_path)],
+            capture_output=True, text=True, cwd=REPO, timeout=600,
+        )
+    finally:
+        try:
+            sec.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            sec.kill()
+    assert sta.returncode == 0, sta.stderr[-2000:]
+    got = _extract_samples(sta.stdout)
+    assert got == want, f"distributed tokens diverge\nwant {want}\ngot  {got}"
