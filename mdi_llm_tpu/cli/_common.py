@@ -60,6 +60,49 @@ def add_run_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--logs-dir", type=Path, default=Path("logs"))
 
 
+def report_run(args, cfg, tokenizer, prompt_ids, outs, stats, gen_time, n_nodes, label):
+    """Print the samples + throughput line and write the tokens/time CSV,
+    plot, and run-stats CSV with the reference's file naming
+    (≡ starter.py:70-105 / sample.py:203-245).  Shared by cli/sample.py and
+    cli/starter.py."""
+    import sys
+
+    import numpy as np
+
+    from mdi_llm_tpu.utils import plots
+
+    for i, (ids, plen) in enumerate(zip(outs, (len(p) for p in prompt_ids))):
+        print(f"--- sample {i} ({len(ids) - plen} new tokens) " + "-" * 30)
+        if tokenizer is not None:
+            print(tokenizer.decode(np.asarray(ids)))
+        else:
+            print(ids)
+    print(
+        f"[{label}] {stats.tokens_generated} tokens in {gen_time:.2f}s — "
+        f"{stats.tokens_per_s:.2f} tok/s decode (prefill {stats.prefill_s:.2f}s)",
+        file=sys.stderr,
+    )
+    if args.plots or args.time_run:
+        csv_path = plots.tok_time_csv_path(
+            args.logs_dir, n_nodes, cfg.name, args.n_samples
+        )
+        plots.write_tok_time_csv(csv_path, stats.tok_time)
+        if args.plots:
+            plots.plot_tokens_per_time(
+                stats.tok_time,
+                csv_path.with_suffix(".png"),
+                label=f"{cfg.name} {n_nodes} node(s)",
+            )
+        if args.time_run:
+            plots.append_run_stats(
+                args.time_run,
+                args.n_samples,
+                cfg.n_layer,
+                args.sequence_length or cfg.block_size,
+                gen_time,
+            )
+
+
 def setup_logging(args) -> logging.Logger:
     level = (
         logging.DEBUG if args.debug else logging.INFO if args.verbose else logging.WARNING

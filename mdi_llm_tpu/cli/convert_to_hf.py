@@ -1,0 +1,55 @@
+"""Inverse checkpoint conversion: framework pytree → HF-layout state dict.
+
+≡ reference `src/sub/utils/convert_lit_checkpoint.py` (lit→HF weight maps,
+QKV un-interleaving).  Writes `pytorch_model.bin` (torch.save) or
+`model.safetensors` next to the source checkpoint so the weights round-trip
+back into `transformers`.
+
+Example:
+    python -m mdi_llm_tpu.cli.convert_to_hf --ckpt checkpoints/custom/NanoLlama --out export/
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ckpt", type=Path, required=True)
+    ap.add_argument("--out", type=Path, default=None, help="default: <ckpt>/hf_export")
+    ap.add_argument(
+        "--format", choices=("safetensors", "bin"), default="safetensors"
+    )
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from mdi_llm_tpu.utils.checkpoint import convert_to_hf_state_dict, load_checkpoint
+
+    cfg, params = load_checkpoint(args.ckpt)
+    sd = convert_to_hf_state_dict(cfg, params)
+    out = args.out or (args.ckpt / "hf_export")
+    out.mkdir(parents=True, exist_ok=True)
+
+    if args.format == "safetensors":
+        try:
+            from safetensors.numpy import save_file
+        except ImportError:  # fall back to torch.save
+            args.format = "bin"
+        else:
+            save_file(dict(sd), str(out / "model.safetensors"))
+    if args.format == "bin":
+        import torch
+
+        torch.save(
+            {k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+            out / "pytorch_model.bin",
+        )
+    print(f"wrote {len(sd)} tensors to {out} ({args.format})")
+
+
+if __name__ == "__main__":
+    main()

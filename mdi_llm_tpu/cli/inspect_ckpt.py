@@ -1,0 +1,74 @@
+"""Checkpoint inspector: dump config, parameter keys/shapes/dtypes, sizes,
+and (optionally) the stage partition.
+
+≡ reference `src/scripts/inspect_lit.py` (litGPT checkpoint key/shape dump)
+and `old/nanoGPT/test_checkpoint.py` (split-correctness inspector: exercises
+`split_parameters` and reports per-chunk sizes).
+
+Examples:
+    python -m mdi_llm_tpu.cli.inspect_ckpt --ckpt checkpoints/custom/NanoLlama
+    python -m mdi_llm_tpu.cli.inspect_ckpt --ckpt <dir> --n-stages 3
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import numpy as np
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ckpt", type=Path, required=True)
+    ap.add_argument("--n-stages", type=int, default=0, help="also show the stage split")
+    ap.add_argument("--keys-only", action="store_true")
+    return ap
+
+
+def _flatten(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _flatten(tree[k], f"{prefix}{k}." if prefix or True else k)
+    else:
+        yield prefix.rstrip("."), np.asarray(tree)
+
+
+def _dump(params, keys_only=False) -> int:
+    total = 0
+    for name, arr in _flatten(params):
+        total += arr.nbytes
+        if keys_only:
+            print(name)
+        else:
+            print(f"{name:60s} {str(arr.dtype):10s} {tuple(arr.shape)}")
+    return total
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from mdi_llm_tpu.utils.checkpoint import load_checkpoint
+
+    cfg, params = load_checkpoint(args.ckpt)
+    n_params = sum(int(np.asarray(a).size) for _, a in _flatten(params))
+    print(f"# {cfg.name}: n_layer={cfg.n_layer} n_head={cfg.n_head} "
+          f"n_embd={cfg.n_embd} n_query_groups={cfg.n_query_groups} "
+          f"block_size={cfg.block_size} padded_vocab={cfg.padded_vocab_size}")
+    print(f"# params: {n_params:,} ({n_params/1e6:.1f}M)")
+    total = _dump(params, args.keys_only)
+    print(f"# total bytes: {total:,} ({total/2**20:.1f} MiB)")
+
+    if args.n_stages > 1:
+        from mdi_llm_tpu.parallel.partition import split_params, stage_layers
+
+        counts = stage_layers(cfg.n_layer, args.n_stages)
+        stages = split_params(cfg, params, args.n_stages)
+        print(f"\n# stage split over {args.n_stages} stages: layers {counts}")
+        for i, st in enumerate(stages):
+            sz = sum(a.nbytes for _, a in _flatten(st))
+            keys = [k for k in st if k != "blocks"]
+            print(f"  stage {i}: {counts[i]} layers, {sz/2**20:.1f} MiB, extras={keys}")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,7 +16,6 @@ Examples:
 from __future__ import annotations
 
 import argparse
-import sys
 import time
 from pathlib import Path
 
@@ -27,10 +26,10 @@ from mdi_llm_tpu.cli._common import (
     add_common_args,
     add_run_args,
     load_model,
+    report_run,
     select_device,
     setup_logging,
 )
-from mdi_llm_tpu.utils import plots
 from mdi_llm_tpu.utils.prompts import get_user_prompt
 
 
@@ -109,39 +108,17 @@ def main(argv=None):
             )
     gen_time = time.perf_counter() - t_load
 
-    for i, (ids, plen) in enumerate(zip(outs, (len(p) for p in prompt_ids))):
-        print(f"--- sample {i} ({len(ids) - plen} new tokens) " + "-" * 30)
-        if tokenizer is not None:
-            print(tokenizer.decode(np.asarray(ids)))
-        else:
-            print(ids)
-    print(
-        f"[{n_nodes} node(s)] {stats.tokens_generated} tokens in "
-        f"{gen_time:.2f}s — {stats.tokens_per_s:.2f} tok/s decode "
-        f"(prefill {stats.prefill_s:.2f}s)",
-        file=sys.stderr,
+    report_run(
+        args, cfg, tokenizer, prompt_ids, outs, stats, gen_time,
+        n_nodes, f"{n_nodes} node(s)",
     )
-
-    if args.plots or args.time_run:
-        csv_path = plots.tok_time_csv_path(
-            args.logs_dir, n_nodes, cfg.name, args.n_samples
-        )
-        plots.write_tok_time_csv(csv_path, stats.tok_time)
-        if args.plots:
-            plots.plot_tokens_per_time(
-                stats.tok_time,
-                csv_path.with_suffix(".png"),
-                label=f"{cfg.name} {n_nodes} node(s)",
-            )
-        if args.time_run:
-            plots.append_run_stats(
-                args.time_run,
-                args.n_samples,
-                cfg.n_layer,
-                seq_len or cfg.block_size,
-                gen_time,
-            )
     return outs
+
+
+def cli() -> int:
+    """Console-script entry (exit code 0, not the samples list)."""
+    main()
+    return 0
 
 
 if __name__ == "__main__":

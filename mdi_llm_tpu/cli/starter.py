@@ -24,7 +24,6 @@ Examples:
 from __future__ import annotations
 
 import argparse
-import sys
 import time
 from pathlib import Path
 
@@ -35,16 +34,17 @@ from mdi_llm_tpu.cli._common import (
     add_common_args,
     add_run_args,
     load_model,
+    report_run,
     select_device,
     setup_logging,
 )
 from mdi_llm_tpu.parallel.nodes import (
     NodesConfig,
     broadcast_run_spec,
+    check_params_consistency,
     init_distributed,
     parse_nodes_config,
 )
-from mdi_llm_tpu.utils import plots
 from mdi_llm_tpu.utils.prompts import get_user_prompt
 
 
@@ -79,9 +79,8 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
     init_distributed(nodes_cfg, process_id)
     is_starter = process_id == 0
 
-    cfg, params, tokenizer, prompt_style = load_model(args, need_tokenizer=is_starter)
-
     if is_starter:
+        cfg, params, tokenizer, prompt_style = load_model(args, need_tokenizer=True)
         raw_prompts = get_user_prompt(args.prompt, args.n_samples)
         if tokenizer is not None:
             styled = [prompt_style.apply(p) for p in raw_prompts]
@@ -101,6 +100,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
             top_p=args.top_p,
             stop_seqs=stop_seqs,
             seed=args.seed,
+            dtype=args.dtype,
             seq_len=args.sequence_length,
             # shape-critical: every process must build the identical SPMD ring
             n_stages=(
@@ -109,9 +109,14 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
                 or jax.device_count()
             ),
         )
+        spec = broadcast_run_spec(spec)
     else:
-        spec = None
-    spec = broadcast_run_spec(spec)
+        spec = broadcast_run_spec(None)
+        # weights load AFTER the spec so random-init mode (--model, no
+        # --ckpt) uses the starter's seed/dtype, not this node's defaults
+        args.seed, args.dtype = spec["seed"], spec["dtype"]
+        cfg, params, tokenizer, prompt_style = load_model(args, need_tokenizer=False)
+    check_params_consistency(params)
 
     from mdi_llm_tpu.parallel.pipeline import PipelineEngine
 
@@ -138,37 +143,11 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         log.info("secondary %d done (%d tokens)", process_id, stats.tokens_generated)
         return outs, stats, gen_time, engine
 
-    for i, (ids, plen) in enumerate(zip(outs, (len(p) for p in spec["prompt_ids"]))):
-        print(f"--- sample {i} ({len(ids) - plen} new tokens) " + "-" * 30)
-        if tokenizer is not None:
-            print(tokenizer.decode(np.asarray(ids)))
-        else:
-            print(ids)
-    print(
-        f"[{nodes_cfg.n_nodes} node(s) / {n_stages} stage(s)] "
-        f"{stats.tokens_generated} tokens in {gen_time:.2f}s — "
-        f"{stats.tokens_per_s:.2f} tok/s decode (prefill {stats.prefill_s:.2f}s)",
-        file=sys.stderr,
+    args.sequence_length = spec["seq_len"]
+    report_run(
+        args, cfg, tokenizer, spec["prompt_ids"], outs, stats, gen_time,
+        nodes_cfg.n_nodes, f"{nodes_cfg.n_nodes} node(s) / {n_stages} stage(s)",
     )
-    if args.plots or args.time_run:
-        csv_path = plots.tok_time_csv_path(
-            args.logs_dir, nodes_cfg.n_nodes, cfg.name, args.n_samples
-        )
-        plots.write_tok_time_csv(csv_path, stats.tok_time)
-        if args.plots:
-            plots.plot_tokens_per_time(
-                stats.tok_time,
-                csv_path.with_suffix(".png"),
-                label=f"{cfg.name} {nodes_cfg.n_nodes} node(s)",
-            )
-        if args.time_run:
-            plots.append_run_stats(
-                args.time_run,
-                args.n_samples,
-                cfg.n_layer,
-                spec["seq_len"] or cfg.block_size,
-                gen_time,
-            )
     return outs, stats, gen_time, engine
 
 
@@ -177,6 +156,12 @@ def main(argv=None):
     nodes_cfg = parse_nodes_config(args.nodes_config)
     outs, _, _, _ = run_node(args, nodes_cfg, process_id=0)
     return outs
+
+
+def cli() -> int:
+    """Console-script entry (exit code 0, not the samples list)."""
+    main()
+    return 0
 
 
 if __name__ == "__main__":

@@ -94,6 +94,10 @@ def parse_nodes_config(path) -> NodesConfig:
     # TPU-native schema
     coord = raw.get("coordinator", "127.0.0.1:8476")
     addr, _, port = coord.rpartition(":")
+    if not addr or not port.isdigit():
+        raise SystemExit(
+            f"{path}: \"coordinator\" must be host:port, got {coord!r}"
+        )
     n_proc = int(raw.get("num_processes", 1))
     starter = NodeInfo(addr=addr or "127.0.0.1", comm_port=int(port))
     secondary = [NodeInfo(addr="?", comm_port=0) for _ in range(n_proc - 1)]
@@ -115,6 +119,39 @@ def init_distributed(cfg: NodesConfig, process_id: int) -> None:
         num_processes=cfg.n_nodes,
         process_id=process_id,
     )
+
+
+def check_params_consistency(params, rtol: float = 1e-3) -> None:
+    """Assert every process holds the same weights (cheap strided-subsample
+    signature, all-gathered host-side).  Catches the silent-garbage failure
+    mode where nodes random-init with different seeds/dtypes or load stale
+    checkpoint copies — a risk the reference avoids by shipping weights in
+    the init message (`model_dist.py:402-484`), which we deliberately don't.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(params):
+        a = np.asarray(leaf).ravel()
+        stride = max(1, a.size // 4096)
+        sig.append(float(np.sum(a[::stride], dtype=np.float64)))
+    sig = np.asarray(sig, np.float64)
+    all_sigs = np.asarray(multihost_utils.process_allgather(sig))
+    ref = all_sigs[0]
+    scale = np.maximum(np.abs(ref), 1.0)
+    bad = [
+        p
+        for p in range(1, all_sigs.shape[0])
+        if np.any(np.abs(all_sigs[p] - ref) / scale > rtol)
+    ]
+    if bad:
+        raise RuntimeError(
+            f"parameter mismatch across processes {bad} vs process 0 — all "
+            "nodes must load the same checkpoint (or random-init from the "
+            "same seed/dtype)"
+        )
 
 
 def broadcast_run_spec(spec: Optional[dict]) -> dict:

@@ -128,9 +128,10 @@ def test_two_process_pipeline_matches_single_device(tmp_path):
         )
     finally:
         try:
-            sec.wait(timeout=120)
+            sec.communicate(timeout=120)
         except subprocess.TimeoutExpired:
             sec.kill()
+            sec.communicate()
     assert sta.returncode == 0, sta.stderr[-2000:]
     got = _extract_samples(sta.stdout)
     assert got == want, f"distributed tokens diverge\nwant {want}\ngot  {got}"

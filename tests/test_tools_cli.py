@@ -1,0 +1,138 @@
+"""Tooling CLIs: inspect_ckpt, model_surgery, convert_to_hf, prepare_owt.
+
+≡ reference dev/maintenance tools: `src/scripts/inspect_lit.py`,
+`old/GPT2/model_surgery.py`, `sub/utils/convert_lit_checkpoint.py`,
+`src/prepare_owt.py`.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.models.transformer import init_params
+from mdi_llm_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+@pytest.fixture(scope="module")
+def saved_ckpt(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tools") / "toy"
+    cfg = Config(
+        name="toy-llama",
+        block_size=64,
+        vocab_size=96,
+        padded_vocab_size=96,
+        n_layer=4,
+        n_head=4,
+        n_embd=32,
+        n_query_groups=2,
+        rotary_percentage=1.0,
+        parallel_residual=False,
+        bias=False,
+        norm_class_name="RMSNorm",
+        mlp_class_name="LLaMAMLP",
+        intermediate_size=64,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    save_checkpoint(params, cfg, d)
+    return d
+
+
+def test_inspect_ckpt(saved_ckpt, capsys):
+    from mdi_llm_tpu.cli.inspect_ckpt import main
+
+    main(["--ckpt", str(saved_ckpt), "--n-stages", "2"])
+    out = capsys.readouterr().out
+    assert "toy-llama" in out and "n_layer=4" in out
+    assert "stage split over 2 stages" in out
+    assert "wte" in out and "lm_head" in out
+
+
+def test_model_surgery_set_and_dry_run(saved_ckpt, capsys):
+    from mdi_llm_tpu.cli.model_surgery import main
+
+    main(["--ckpt", str(saved_ckpt), "--set", "block_size=32", "--dry-run"])
+    cfg, _ = load_checkpoint(saved_ckpt)
+    assert cfg.block_size == 64  # dry run: unchanged
+
+    main(["--ckpt", str(saved_ckpt), "--set", "block_size=32"])
+    cfg, _ = load_checkpoint(saved_ckpt)
+    assert cfg.block_size == 32
+
+    with pytest.raises(SystemExit):
+        main(["--ckpt", str(saved_ckpt), "--set", "nonsense_field=1"])
+
+
+def test_convert_to_hf_roundtrip(saved_ckpt, tmp_path):
+    from mdi_llm_tpu.cli.convert_to_hf import main
+    from mdi_llm_tpu.utils.checkpoint import convert_to_hf_state_dict
+
+    out = tmp_path / "export"
+    main(["--ckpt", str(saved_ckpt), "--out", str(out)])
+    files = list(out.iterdir())
+    assert len(files) == 1 and files[0].suffix in (".safetensors", ".bin")
+
+    cfg, params = load_checkpoint(saved_ckpt)
+    sd = convert_to_hf_state_dict(cfg, params)
+    assert "model.embed_tokens.weight" in sd
+    assert any(k.startswith("model.layers.3.") for k in sd)
+
+
+def test_prepare_owt_local_dataset(tmp_path):
+    datasets = pytest.importorskip("datasets")
+    from tokenizers import Tokenizer as HFTok
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    import json
+
+    from mdi_llm_tpu.cli.prepare_owt import main
+    from mdi_llm_tpu.utils.data_loader import open_bin
+
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    words = "alpha beta gamma delta epsilon zeta".split()
+    vocab = {"<s>": 0, "</s>": 1, "<unk>": 2}
+    for w in words:
+        vocab[w] = len(vocab)
+    t = HFTok(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Whitespace()
+    t.save(str(tok_dir / "tokenizer.json"))
+    (tok_dir / "tokenizer_config.json").write_text(
+        json.dumps({"bos_token": "<s>", "eos_token": "</s>", "add_bos_token": False})
+    )
+
+    docs = [" ".join(np.random.default_rng(i).choice(words, 20)) for i in range(40)]
+    ds = datasets.Dataset.from_dict({"text": docs})
+    ds_dir = tmp_path / "ds"
+    ds.save_to_disk(str(ds_dir))
+
+    out = tmp_path / "bins"
+    main([
+        "--dataset", str(ds_dir), "--ckpt", str(tok_dir), "--out", str(out),
+        "--num-proc", "1", "--val-frac", "0.1",
+    ])
+    train = open_bin(out / "train.bin")
+    val = open_bin(out / "val.bin")
+    assert len(train) > len(val) > 0
+    assert int(np.max(train)) < len(vocab)
+
+
+def test_console_utils(capsys):
+    import io
+
+    from mdi_llm_tpu.utils.console import get_obj_size, loading_bar, waiting_animation
+
+    assert loading_bar(0, 10) == "[" + " " * 20 + "]"
+    assert loading_bar(10, 10) == "[" + "=" * 20 + "]"
+    mid = loading_bar(5, 10)
+    assert mid.count("=") == 9 and ">" in mid
+
+    buf = io.StringIO()  # not a tty: spinner must stay silent
+    with waiting_animation("busy", stream=buf):
+        pass
+    assert buf.getvalue() == ""
+
+    small, big = get_obj_size([1]), get_obj_size([list(range(100)), "x" * 1000])
+    assert big > small > 0
