@@ -35,6 +35,7 @@ def main():
     ap.add_argument("--seq-len", type=int, default=1024)
     ap.add_argument("--pipeline", type=int, default=0, help="run N-stage pipeline engine")
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--quantize", choices=("none", "int8"), default="none")
     ap.add_argument("--chunk", type=int, default=128, help="decode steps per jit call")
     args = ap.parse_args()
 
@@ -65,9 +66,10 @@ def main():
         from mdi_llm_tpu.generation import Generator
 
         engine = Generator(
-            cfg, params, max_seq_length=args.seq_len, cache_dtype=dtype
+            cfg, params, max_seq_length=args.seq_len, cache_dtype=dtype,
+            quantize=args.quantize,
         )
-        label = "batched-decode"
+        label = "batched-decode" + ("+int8" if args.quantize == "int8" else "")
 
     kwargs = {} if args.pipeline else {"chunk_size": args.chunk}
     # warmup (compile)

@@ -39,6 +39,12 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
         "--sequence-length", type=int, default=None, help="truncate max context"
     )
     ap.add_argument("--device", default=None, help="jax platform override (tpu/cpu)")
+    ap.add_argument(
+        "--quantize",
+        choices=("none", "int8"),
+        default="none",
+        help="weight-only quantization (int8 halves HBM traffic per decode step)",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--debug", action="store_true")
 
@@ -82,6 +88,8 @@ def report_run(args, cfg, tokenizer, prompt_ids, outs, stats, gen_time, n_nodes,
         f"{stats.tokens_per_s:.2f} tok/s decode (prefill {stats.prefill_s:.2f}s)",
         file=sys.stderr,
     )
+    if stats.interrupted:
+        print("WARNING: generation interrupted — output is partial", file=sys.stderr)
     if args.plots or args.time_run:
         csv_path = plots.tok_time_csv_path(
             args.logs_dir, n_nodes, cfg.name, args.n_samples

@@ -41,7 +41,10 @@ def main(argv=None):
     if tokenizer is None:
         raise SystemExit("chat needs a checkpoint with a tokenizer (--ckpt)")
     stop_seqs = prompt_style.stop_tokens(tokenizer)
-    gen = Generator(cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed)
+    gen = Generator(
+        cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed,
+        quantize=args.quantize,
+    )
 
     print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")
     history: list[int] = []

@@ -89,7 +89,7 @@ def main(argv=None):
 
             engine = PipelineEngine(
                 cfg, params, n_stages=args.pipeline_stages, max_seq_length=seq_len,
-                rng_seed=args.seed,
+                rng_seed=args.seed, quantize=args.quantize,
             )
             n_nodes = args.pipeline_stages
             outs, stats = engine.generate(
@@ -99,7 +99,10 @@ def main(argv=None):
         else:
             from mdi_llm_tpu.generation import Generator
 
-            engine = Generator(cfg, params, max_seq_length=seq_len, rng_seed=args.seed)
+            engine = Generator(
+                cfg, params, max_seq_length=seq_len, rng_seed=args.seed,
+                quantize=args.quantize,
+            )
             n_nodes = 1
             outs, stats = engine.generate(
                 prompt_ids, args.n_tokens, temperature=temperature,
@@ -112,6 +115,8 @@ def main(argv=None):
         args, cfg, tokenizer, prompt_ids, outs, stats, gen_time,
         n_nodes, f"{n_nodes} node(s)",
     )
+    if stats.interrupted:
+        raise SystemExit(130)  # conventional SIGINT exit code
     return outs
 
 

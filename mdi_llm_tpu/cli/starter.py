@@ -101,6 +101,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
             stop_seqs=stop_seqs,
             seed=args.seed,
             dtype=args.dtype,
+            quantize=args.quantize,
             seq_len=args.sequence_length,
             # shape-critical: every process must build the identical SPMD ring
             n_stages=(
@@ -127,6 +128,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         n_stages=n_stages,
         max_seq_length=spec["seq_len"],
         rng_seed=spec["seed"],
+        quantize=spec["quantize"],
     )
     t0 = time.perf_counter()
     outs, stats = engine.generate(
@@ -148,6 +150,8 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         args, cfg, tokenizer, spec["prompt_ids"], outs, stats, gen_time,
         nodes_cfg.n_nodes, f"{nodes_cfg.n_nodes} node(s) / {n_stages} stage(s)",
     )
+    if stats.interrupted:
+        raise SystemExit(130)  # conventional SIGINT exit code
     return outs, stats, gen_time, engine
 
 

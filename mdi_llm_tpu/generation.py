@@ -35,6 +35,7 @@ import numpy as np
 
 from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
 from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.utils.context_managers import catch_loop_errors
 from mdi_llm_tpu.ops.sampling import sample
 
 
@@ -86,6 +87,8 @@ class GenerationStats:
     prefill_s: float = 0.0
     decode_s: float = 0.0
     tokens_generated: int = 0
+    # True when the decode loop ended on Ctrl-C (partial output)
+    interrupted: bool = False
 
     @property
     def tokens_per_s(self) -> float:
@@ -105,11 +108,18 @@ class Generator:
         cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
         use_flash: Optional[bool] = None,  # None → auto (TPU backend)
+        quantize: Optional[str] = None,  # None | "int8" (weight-only)
     ):
         self.cfg = cfg
+        if quantize == "int8":
+            from mdi_llm_tpu.ops.quant import quantize_params
+
+            params = quantize_params(params)
+        elif quantize not in (None, "none"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         self.params = params
         if cache_dtype is None:
-            cache_dtype = jax.tree_util.tree_leaves(params)[0].dtype
+            cache_dtype = transformer.param_dtype(params)
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"
         self.use_flash = use_flash
@@ -270,28 +280,32 @@ class Generator:
 
         n = 1
         emit(tok, n)
-        while n < max_new_tokens and not all(done):
-            room = self.max_seq_length - int(positions.max()) - 1
-            k = min(chunk_size, max_new_tokens - n, room)
-            if k < 1:
-                break
-            toks_j, kv, self.key = self._decode_chunk_fn(B, k)(
-                self.params,
-                jnp.asarray(tok, jnp.int32),
-                kv,
-                jnp.asarray(positions),
-                self.key,
-                temperature=temperature,
-                top_k=top_k,
-                top_p=top_p,
-            )
-            toks_np = np.asarray(toks_j)  # (k, B)
-            for i in range(k):
-                n += 1
-                emit(toks_np[i], n)
-            tok = toks_np[-1]
-            positions = positions + k
+        # Ctrl-C mid-loop returns what was generated so far
+        # (≡ catch_loop_errors clean shutdown, context_managers.py:16-57)
+        with catch_loop_errors() as guard:
+            while n < max_new_tokens and not all(done):
+                room = self.max_seq_length - int(positions.max()) - 1
+                k = min(chunk_size, max_new_tokens - n, room)
+                if k < 1:
+                    break
+                toks_j, kv, self.key = self._decode_chunk_fn(B, k)(
+                    self.params,
+                    jnp.asarray(tok, jnp.int32),
+                    kv,
+                    jnp.asarray(positions),
+                    self.key,
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                )
+                toks_np = np.asarray(toks_j)  # (k, B)
+                for i in range(k):
+                    n += 1
+                    emit(toks_np[i], n)
+                tok = toks_np[-1]
+                positions = positions + k
 
+        stats.interrupted = guard.interrupted
         stats.decode_s = time.perf_counter() - t_dec
         stats.tokens_generated = sum(len(o) - l for o, l in zip(out, lens))
 

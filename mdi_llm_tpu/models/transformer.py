@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from mdi_llm_tpu.config import Config
 from mdi_llm_tpu.ops.attention import multihead_attention
 from mdi_llm_tpu.ops.norms import layer_norm, rms_norm
+from mdi_llm_tpu.ops.quant import quantized_einsum
 from mdi_llm_tpu.ops.rope import apply_rope, build_rope_cache
 
 Params = Dict[str, Any]
@@ -48,7 +49,7 @@ KVCache = Dict[str, jnp.ndarray]  # {"k": (L,B,G,S,hs), "v": (L,B,G,S,hs)}
 
 
 def linear(x: jnp.ndarray, p: Params) -> jnp.ndarray:
-    y = jnp.einsum("...i,oi->...o", x, p["weight"])
+    y = quantized_einsum("...i,oi->...o", x, p)
     if "bias" in p:
         y = y + p["bias"]
     return y
@@ -94,7 +95,7 @@ def moe_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     expert-parallel sharded variant lives in `parallel/expert.py`.
     """
     E = cfg.n_expert
-    router = jnp.einsum("...i,ei->...e", x, p["gate"]["weight"]).astype(jnp.float32)
+    router = quantized_einsum("...i,ei->...e", x, p["gate"]).astype(jnp.float32)
     probs = jax.nn.softmax(router, axis=-1)  # (..., E)
     topv, topi = jax.lax.top_k(probs, cfg.n_expert_per_token)
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
@@ -103,10 +104,10 @@ def moe_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     dense_w = jnp.einsum("...k,...ke->...e", topv, onehot)  # (..., E)
 
     # expert params have a leading E axis: fc_1 (E, I, D) etc.
-    h1 = jnp.einsum("...d,eid->...ei", x, p["experts"]["fc_1"]["weight"])
-    h2 = jnp.einsum("...d,eid->...ei", x, p["experts"]["fc_2"]["weight"])
+    h1 = quantized_einsum("...d,eid->...ei", x, p["experts"]["fc_1"])
+    h2 = quantized_einsum("...d,eid->...ei", x, p["experts"]["fc_2"])
     h = jax.nn.silu(h1) * h2
-    out = jnp.einsum("...ei,edi->...ed", h, p["experts"]["proj"]["weight"])
+    out = quantized_einsum("...ei,edi->...ed", h, p["experts"]["proj"])
     return jnp.einsum("...ed,...e->...d", out, dense_w.astype(out.dtype)).astype(x.dtype)
 
 
@@ -304,7 +305,7 @@ def head(cfg: Config, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     submodels.py:203-218)."""
     x = _norm(cfg, x, params["ln_f"])
     w = params["wte"] if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("...d,vd->...v", x, w["weight"])
+    logits = quantized_einsum("...d,vd->...v", x, w)
     if cfg.lm_head_bias:
         logits = logits + params["lm_head"]["bias"]
     return logits
@@ -464,7 +465,26 @@ def count_params(params: Params) -> int:
 
 
 def cast_params(params: Params, dtype) -> Params:
-    return jax.tree_util.tree_map(lambda x: x.astype(dtype), params)
+    """Cast float leaves; integer leaves (int8 quantized weights) pass
+    through untouched."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params,
+    )
+
+
+def param_dtype(params: Params):
+    """Dtype of the first floating *weight* leaf.  Skips the f32 "scale"
+    vectors of int8-quantized linears, which would otherwise win the
+    sorted-key flattening order and silently flip KV caches / pipeline
+    payloads to f32."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        last = path[-1]
+        if getattr(last, "key", None) == "scale":
+            continue
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.dtype
+    raise ValueError("no floating weight leaves in param tree")
 
 
 def slice_blocks(blocks: Params, start: int, stop: int) -> Params:

@@ -1,0 +1,84 @@
+"""Weight-only int8 quantization for decode-time memory bandwidth.
+
+Beyond-reference capability (the reference runs fp16/bf16 only;
+`gptserver.py:199-209` dtype selection): batched autoregressive decode on
+TPU is HBM-bandwidth-bound on weight reads, so storing linear weights as
+per-output-channel symmetric int8 halves the bytes/step versus bf16.  The
+dequantize stays INSIDE the matmul:
+
+    y = einsum(x, q.astype(x.dtype)) * scale        # scale: per out channel
+
+which is algebraically identical to einsum(x, q*scale) because the scale
+factors out of the contraction, and lets XLA fuse the int8→bf16 convert
+into the dot's operand read instead of materializing a bf16 copy.
+
+Quantized layout: a linear's param dict {"weight": (..., out, in)} becomes
+{"weight_q": int8 (..., out, in), "scale": f32 (..., out)}.  1-D weights
+(norms), biases, and the embedding table (gather path, also the tied head)
+are left in the original dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+# param-tree keys never quantized: embeddings feed gathers and tied heads;
+# norm weights are vectors (per-layer-stacked they look 2-D, hence by name)
+SKIP_KEYS = ("wte", "wpe", "norm_1", "norm_2", "ln_f")
+
+
+def quantize_tensor(w: np.ndarray):
+    """Per-output-channel symmetric int8: scale over the last (input) axis.
+    Works for stacked layouts too ((L, out, in) → scale (L, out))."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=-1)
+    scale = (amax / 127.0).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.clip(np.round(w / safe[..., None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_tensor(q: np.ndarray, scale: np.ndarray, dtype=np.float32):
+    return (np.asarray(q, np.float32) * np.asarray(scale, np.float32)[..., None]).astype(dtype)
+
+
+def is_quantized(p: Params) -> bool:
+    return isinstance(p, dict) and "weight_q" in p
+
+
+def quantize_params(params: Params, skip: Sequence[str] = SKIP_KEYS) -> Params:
+    """Walk a param tree, replacing every >=2-D "weight" (outside `skip`
+    subtrees) with int8 weight_q + f32 scale.  Biases/norm weights pass
+    through unchanged."""
+
+    def walk(node, name):
+        if not isinstance(node, dict):
+            return node
+        if name in skip:
+            return node
+        out = {}
+        for k, v in node.items():
+            if k == "weight" and np.asarray(v).ndim >= 2:
+                q, s = quantize_tensor(np.asarray(v))
+                out["weight_q"], out["scale"] = q, s
+            else:
+                out[k] = walk(v, k)
+        return out
+
+    return walk(params, "")
+
+
+def quantized_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:
+    """einsum against a (possibly) quantized weight dict.  `spec` contracts
+    x with the stored (out, in)-layout weight; the per-out-channel scale is
+    applied to the result (exact: it factors out of the contraction)."""
+    if is_quantized(p):
+        y = jnp.einsum(spec, x, p["weight_q"].astype(x.dtype))
+        return y * p["scale"].astype(x.dtype)
+    return jnp.einsum(spec, x, p["weight"])

@@ -26,7 +26,8 @@ def logits_to_probs(
     if temperature > 0:
         logits = logits / temperature
     if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        # lax.top_k(k) beats a full-vocab sort for the kth threshold
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.nn.softmax(logits, axis=-1)
 
@@ -75,6 +76,6 @@ def sample(
         return sample_top_p(logits, key, top_p, temperature)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)

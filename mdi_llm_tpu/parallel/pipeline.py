@@ -54,6 +54,7 @@ from mdi_llm_tpu.generation import (
 )
 from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.ops.sampling import sample
+from mdi_llm_tpu.utils.context_managers import catch_loop_errors
 from mdi_llm_tpu.parallel.mesh import pipeline_mesh
 from mdi_llm_tpu.parallel.partition import split_params, stage_layers
 
@@ -91,9 +92,16 @@ class PipelineEngine:
         cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
         devices: Optional[Sequence] = None,
+        quantize: Optional[str] = None,  # None | "int8" (weight-only)
     ):
+        if quantize == "int8":
+            from mdi_llm_tpu.ops.quant import quantize_params
+
+            params = quantize_params(params)
+        elif quantize not in (None, "none"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         if cache_dtype is None:
-            cache_dtype = jax.tree_util.tree_leaves(params)[0].dtype
+            cache_dtype = transformer.param_dtype(params)
         if mesh is None:
             mesh = pipeline_mesh(n_stages or len(devices or jax.devices()), devices)
         self.mesh = mesh
@@ -418,6 +426,8 @@ class PipelineEngine:
             )
             for i, o in enumerate(outs):
                 results[wave_start + i] = o
+            if stats.interrupted:
+                break  # Ctrl-C: skip remaining waves, return partials
         stats.decode_s = time.perf_counter() - t_all - stats.prefill_s
         stats.tokens_generated = sum(
             len(o) - len(p) for o, p in zip(results, prompts)
@@ -444,7 +454,7 @@ class PipelineEngine:
             prompts_np[i, : lens[i]] = np.asarray(p, np.int32)
 
         kv = self._init_kv()
-        dtype = jax.tree_util.tree_leaves(self.stage_blocks)[0].dtype
+        dtype = transformer.param_dtype(self.stage_blocks)
 
         # ---- phase 1: pipelined prefill ----
         t_p = time.perf_counter()
@@ -485,36 +495,40 @@ class PipelineEngine:
         for j in range(W):
             ov[j] = (1, j, first_tok[j], lens[j])
         seeded = False
-        while n_tok < max_new_tokens and not all(done):
-            if max(lens) + n_tok + 1 > self.max_seq_length:
-                break
-            self.key, sub = jax.random.split(self.key)
-            kv, payload, emits = decode(
-                self.stage_blocks,
-                self.head_params,
-                self.rope,
-                kv,
-                payload,
-                jnp.asarray(ov),
-                sub,
-            )
-            if not seeded:
-                # the seeding rotation emits only bubble payloads
-                ov = np.zeros((S, 4), np.int32)
-                seeded = True
-                continue
-            toks_e, sids_e, vals_e = (np.asarray(e)[:, 0] for e in emits)
-            for t, s, v in zip(toks_e, sids_e, vals_e):
-                s = int(s)
-                if v and s < W and not done[s]:
-                    out[s].append(int(t))
-                    if detect_stop_tokens(out[s][lens[s] :], stop_sequences):
-                        done[s] = True
-            n_tok += 1
-            stats.tok_time.append(
-                (sum(len(o) - l for o, l in zip(out, lens)), time.perf_counter() - t_all)
-            )
+        # Ctrl-C mid-ring returns partial results (single-process; in a
+        # multi-process job an interrupt tears down the whole SPMD group)
+        with catch_loop_errors() as guard:
+            while n_tok < max_new_tokens and not all(done):
+                if max(lens) + n_tok + 1 > self.max_seq_length:
+                    break
+                self.key, sub = jax.random.split(self.key)
+                kv, payload, emits = decode(
+                    self.stage_blocks,
+                    self.head_params,
+                    self.rope,
+                    kv,
+                    payload,
+                    jnp.asarray(ov),
+                    sub,
+                )
+                if not seeded:
+                    # the seeding rotation emits only bubble payloads
+                    ov = np.zeros((S, 4), np.int32)
+                    seeded = True
+                    continue
+                toks_e, sids_e, vals_e = (np.asarray(e)[:, 0] for e in emits)
+                for t, s, v in zip(toks_e, sids_e, vals_e):
+                    s = int(s)
+                    if v and s < W and not done[s]:
+                        out[s].append(int(t))
+                        if detect_stop_tokens(out[s][lens[s] :], stop_sequences):
+                            done[s] = True
+                n_tok += 1
+                stats.tok_time.append(
+                    (sum(len(o) - l for o, l in zip(out, lens)), time.perf_counter() - t_all)
+                )
 
+        stats.interrupted = stats.interrupted or guard.interrupted
         trimmed = []
         for o, l in zip(out, lens):
             gen = o[l:]

@@ -1,0 +1,55 @@
+"""Loop guards: turn exceptions/Ctrl-C inside host-driven decode loops into
+clean shutdown with partial results.
+
+≡ reference `src/sub/utils/context_managers.py:16-57` (`catch_loop_errors`
+clears the `running` Event and sets/clears the queue Events so socket
+threads exit).  Here there are no threads to unwind — the analog is: stop
+issuing device work, let in-flight XLA dispatches drain, and hand back what
+was generated so far.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+log = logging.getLogger("mdi_llm_tpu")
+
+
+class LoopInterrupted(Exception):
+    """Raised internally when a guarded loop should stop early."""
+
+
+class catch_loop_errors:
+    """Context manager guarding a host-driven generation/training loop.
+
+    with catch_loop_errors(on_stop=engine_cleanup) as guard:
+        while ...:
+            step()
+    # guard.interrupted is True if the loop ended on Ctrl-C
+
+    KeyboardInterrupt is swallowed (the loop body is expected to exit via
+    the exception propagating out of the `with` body) so callers can return
+    partial output; other exceptions run `on_stop` then re-raise.
+    """
+
+    def __init__(self, on_stop: Optional[Callable[[], None]] = None):
+        self.on_stop = on_stop
+        self.interrupted = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            return False
+        if self.on_stop is not None:
+            try:
+                self.on_stop()
+            except Exception:  # cleanup must not mask the original error
+                log.exception("loop cleanup failed")
+        if exc_type in (KeyboardInterrupt, LoopInterrupted):
+            self.interrupted = True
+            log.warning("generation interrupted — returning partial results")
+            return True
+        return False

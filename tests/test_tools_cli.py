@@ -136,3 +136,60 @@ def test_console_utils(capsys):
 
     small, big = get_obj_size([1]), get_obj_size([list(range(100)), "x" * 1000])
     assert big > small > 0
+
+
+def test_catch_loop_errors():
+    from mdi_llm_tpu.utils.context_managers import LoopInterrupted, catch_loop_errors
+
+    # KeyboardInterrupt is swallowed, partial results survive
+    collected = []
+    cleaned = []
+    with catch_loop_errors(on_stop=lambda: cleaned.append(1)) as guard:
+        collected.append(1)
+        raise KeyboardInterrupt
+    assert guard.interrupted and collected == [1] and cleaned == [1]
+
+    with catch_loop_errors() as guard:
+        pass
+    assert not guard.interrupted
+
+    # real errors still propagate (after cleanup)
+    cleaned.clear()
+    with pytest.raises(ValueError):
+        with catch_loop_errors(on_stop=lambda: cleaned.append(1)):
+            raise ValueError("boom")
+    assert cleaned == [1]
+
+    with pytest.raises(ValueError):  # cleanup failure must not mask the error
+        with catch_loop_errors(on_stop=lambda: 1 / 0):
+            raise ValueError("boom")
+
+
+def test_generator_interrupt_returns_partial():
+    import jax
+
+    from mdi_llm_tpu.config import Config
+    from mdi_llm_tpu.generation import Generator
+    from mdi_llm_tpu.models.transformer import init_params
+
+    cfg = Config(
+        name="tiny", block_size=64, vocab_size=64, padded_vocab_size=64,
+        n_layer=2, n_head=2, n_embd=16, rotary_percentage=1.0,
+        parallel_residual=False, bias=False, norm_class_name="RMSNorm",
+        mlp_class_name="LLaMAMLP", intermediate_size=32,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    g = Generator(cfg, params, rng_seed=1)
+
+    hits = []
+
+    def boom(b, t):
+        hits.append(t)
+        if len(hits) >= 3:
+            raise KeyboardInterrupt
+
+    outs, stats = g.generate(
+        [[1, 2, 3]], 20, temperature=0.0, stream_cb=boom, chunk_size=2
+    )
+    assert 3 <= len(outs[0]) - 3 < 20  # partial, not full
+    assert stats.interrupted
