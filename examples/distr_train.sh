@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Example multi-chip training launch (≡ reference src/distr_train.sh, which
+# wraps torchrun for DDP NanoLlama training).  On TPU the mesh replaces
+# torchrun: one process per host, XLA inserts the gradient collectives.
+set -euo pipefail
+
+CKPT=${1:-checkpoints/custom/NanoLlama}
+DATA=${2:-data/shakespeare}
+
+python -m mdi_llm_tpu.cli.train \
+    --ckpt "$CKPT" \
+    --dataset "$DATA" \
+    --mesh dp=-1 \
+    --batch-size 8 --grad-acc-steps 4 \
+    --max-iters 2000 --ckpt-interval 200
