@@ -111,12 +111,31 @@ def report_run(args, cfg, tokenizer, prompt_ids, outs, stats, gen_time, n_nodes,
             )
 
 
-def setup_logging(args) -> logging.Logger:
+def setup_logging(args, role: str = None) -> logging.Logger:
+    """Console logging; with --debug and a node role, also a per-role file
+    under logs/ (≡ reference `logs/logs_{starter,finisher}.log`,
+    starter.py:35-44 / secondary.py:29-38)."""
     level = (
         logging.DEBUG if args.debug else logging.INFO if args.verbose else logging.WARNING
     )
     logging.basicConfig(level=level, format="%(asctime)s %(name)s %(message)s")
-    return logging.getLogger("mdi_llm_tpu")
+    log = logging.getLogger("mdi_llm_tpu")
+    if args.debug and role:
+        logs_dir = Path(getattr(args, "logs_dir", None) or "logs")
+        logs_dir.mkdir(parents=True, exist_ok=True)
+        path = logs_dir / f"logs_{role}.log"
+        # idempotent: repeat calls (retries, tests) must not stack handlers
+        for h in list(log.handlers):
+            if isinstance(h, logging.FileHandler) and h.baseFilename == str(
+                path.resolve()
+            ):
+                h.close()
+                log.removeHandler(h)
+        fh = logging.FileHandler(path)
+        fh.setLevel(logging.DEBUG)
+        fh.setFormatter(logging.Formatter("%(asctime)s %(name)s %(message)s"))
+        log.addHandler(fh)
+    return log
 
 
 def select_device(args) -> None:

@@ -1,0 +1,83 @@
+"""Evaluation CLI: mean cross-entropy loss + perplexity on a token bin.
+
+Standalone version of the reference's in-training `estimate_loss`
+(`src/sub/utils/utils.py:61-107`, invoked at checkpoint intervals,
+`train.py:280-311`) so a checkpoint can be scored without running the
+trainer.  Prints one JSON line.
+
+Example:
+    python -m mdi_llm_tpu.cli.evaluate --ckpt checkpoints/custom/NanoLlama \
+        --dataset data/shakespeare --split val --eval-iters 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+from pathlib import Path
+
+import numpy as np
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ckpt", type=Path, required=True)
+    ap.add_argument("--dataset", type=Path, required=True, help="dir with <split>.bin")
+    ap.add_argument("--split", default="val", choices=("train", "val"))
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--eval-iters", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=10137)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--device", default=None)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax
+
+    from mdi_llm_tpu.cli._common import DTYPES, select_device
+
+    select_device(args)
+    import jax.numpy as jnp
+
+    from mdi_llm_tpu.training import cross_entropy_loss
+    from mdi_llm_tpu.utils import data_loader
+    from mdi_llm_tpu.utils.checkpoint import load_checkpoint
+
+    dtype = DTYPES[args.dtype]
+    cfg, params = load_checkpoint(args.ckpt, dtype=dtype)
+    block_size = int(args.block_size or cfg.block_size)
+
+    # eval-only: no optimizer state, no train-step compile (a Trainer would
+    # allocate 2x param memory in AdamW moments it never uses)
+    eval_fn = jax.jit(
+        lambda p, x, y: cross_entropy_loss(cfg, p, x, y, remat=False)
+    )
+    bin_path = args.dataset / f"{args.split}.bin"
+    data = data_loader.open_bin(bin_path)
+    rng = np.random.default_rng(args.seed)
+    losses = []
+    for _ in range(args.eval_iters):
+        x, y = data_loader.get_batch(data, args.batch_size, block_size, rng)
+        losses.append(float(eval_fn(params, jnp.asarray(x), jnp.asarray(y))))
+    loss = float(np.mean(losses))
+    print(
+        json.dumps(
+            {
+                "ckpt": str(args.ckpt),
+                "split": args.split,
+                "tokens": int(len(data)),
+                "eval_iters": args.eval_iters,
+                "loss": round(loss, 4),
+                "perplexity": round(math.exp(min(loss, 20.0)), 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
     add_common_args(ap)
     add_run_args(ap)
     ap.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
+    ap.add_argument(
+        "--speculative", type=int, default=0, metavar="K",
+        help="greedy speculative decoding with K-token n-gram drafts "
+        "(single sample, temperature 0; exact)",
+    )
     ap.add_argument("--pipeline-stages", type=int, default=0)
     # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
@@ -75,6 +80,17 @@ def main(argv=None):
         stop_seqs = ()
 
     temperature = 0.0 if args.greedy else args.temperature
+    if args.speculative:
+        if args.pipeline_stages:
+            raise SystemExit(
+                "--speculative applies to single-device decode only "
+                "(drop --pipeline-stages)"
+            )
+        if temperature != 0.0 or args.n_samples != 1:
+            raise SystemExit(
+                "--speculative requires --greedy (or --temperature 0) and "
+                "--n-samples 1"
+            )
     seq_len = args.sequence_length
 
     from mdi_llm_tpu.utils.profiling import profile
@@ -108,6 +124,7 @@ def main(argv=None):
                 prompt_ids, args.n_tokens, temperature=temperature,
                 top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
                 chunk_size=args.chunk,
+                speculative=args.speculative or None,
             )
     gen_time = time.perf_counter() - t_load
 

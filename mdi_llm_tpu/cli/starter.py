@@ -70,7 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
 def run_node(args, nodes_cfg: NodesConfig, process_id: int):
     """Shared starter/secondary body: join the job, load the model, receive
     (or originate) the run spec, and execute the SPMD pipeline ring."""
-    log = setup_logging(args)
+    log = setup_logging(
+        args, role="starter" if process_id == 0 else f"secondary{process_id - 1}"
+    )
     # device priority: CLI > node JSON > auto (≡ gptserver.py:601-617)
     node = nodes_cfg.starter if process_id == 0 else nodes_cfg.secondary[process_id - 1]
     if not args.device and node.device:

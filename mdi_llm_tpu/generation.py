@@ -69,6 +69,21 @@ def find_eot(tokens: Sequence[int], stop_sequences: Sequence[Sequence[int]]) -> 
     return best
 
 
+def ngram_draft(tokens: Sequence[int], k: int, ngram: int = 3) -> List[int]:
+    """Prompt-lookup drafting for speculative decoding: find the most recent
+    earlier occurrence of the trailing `ngram` tokens and propose the k
+    tokens that followed it.  Cheap, model-free, and effective whenever the
+    continuation echoes earlier context (code, structured text, chat)."""
+    tokens = list(tokens)
+    if len(tokens) <= ngram:
+        return []
+    tail = tokens[-ngram:]
+    for start in range(len(tokens) - ngram - 1, -1, -1):
+        if tokens[start : start + ngram] == tail:
+            return tokens[start + ngram : start + ngram + k]
+    return []
+
+
 def _bucket(n: int, minimum: int = 16) -> int:
     b = minimum
     while b < n:
@@ -207,6 +222,25 @@ class Generator:
             self._decode_chunk_fns[key_] = decode_chunk
         return self._decode_chunk_fns[key_]
 
+    def _verify_fn(self, T: int):
+        """Greedy verification forward for speculative decoding: score T
+        tokens (last accepted + T-1 drafted) in one pass, return the greedy
+        successor at every position.  Exactness relies on attention masking
+        strictly by absolute position (ops/attention.py), so stale cache
+        entries past a rejected draft are invisible until overwritten."""
+        key_ = ("verify", T)
+        if key_ not in self._decode_chunk_fns:
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def verify(params, tokens, kv, input_pos):
+                logits, kv = transformer.forward(
+                    self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope
+                )
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+            self._decode_chunk_fns[key_] = verify
+        return self._decode_chunk_fns[key_]
+
     # -- public API ----------------------------------------------------------
 
     def generate(
@@ -219,6 +253,7 @@ class Generator:
         stop_sequences: Sequence[Sequence[int]] = (),
         stream_cb=None,
         chunk_size: int = 16,
+        speculative: Optional[int] = None,
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for a batch of token-id prompts.
 
@@ -230,7 +265,19 @@ class Generator:
         amortize host-dispatch latency; stop sequences are checked between
         chunks, so up to chunk_size-1 extra tokens are computed then
         discarded — the token stream itself is unchanged.
+
+        `speculative=K` enables greedy speculative decoding with
+        prompt-lookup (n-gram) drafting: K tokens are drafted from earlier
+        context and verified in one forward pass, emitting up to K+1 tokens
+        per dispatch.  Exact (token-identical to plain greedy); requires
+        temperature == 0 and a single sample.
         """
+        if speculative:
+            if temperature != 0.0 or len(prompts) != 1:
+                raise ValueError(
+                    "speculative decoding requires temperature=0 and exactly "
+                    "one prompt (it is a latency optimization for B=1 greedy)"
+                )
         B = len(prompts)
         lens = [len(p) for p in prompts]
         if min(lens) < 1:
@@ -280,10 +327,43 @@ class Generator:
 
         n = 1
         emit(tok, n)
+
+        # ---- speculative fast path (B=1 greedy): draft K via n-gram lookup,
+        # verify in one forward, emit the matching prefix + bonus token ----
+        if speculative:
+            K = int(speculative)
+            with catch_loop_errors() as g_spec:
+                while (
+                    n < max_new_tokens
+                    and not done[0]
+                    and self.max_seq_length - int(positions[0]) - 1 >= K + 1
+                ):
+                    draft = ngram_draft(out[0], K)
+                    draft = (list(draft) + [0] * K)[:K]
+                    toks_in = np.asarray([[int(tok[0])] + draft], np.int32)
+                    g, kv = self._verify_fn(K + 1)(
+                        self.params, jnp.asarray(toks_in), kv, jnp.asarray(positions)
+                    )
+                    g = np.asarray(g)[0]  # greedy successor at each position
+                    a = 0
+                    while a < K and draft[a] == int(g[a]):
+                        a += 1
+                    emitted = [int(x) for x in g[: a + 1]]
+                    allowed = min(len(emitted), max_new_tokens - n)
+                    for t in emitted[:allowed]:
+                        n += 1
+                        emit(np.asarray([t]), n)
+                        if done[0]:
+                            break
+                    tok = np.asarray([emitted[allowed - 1]], np.int32)
+                    positions = positions + allowed
+            stats.interrupted = g_spec.interrupted
+            # the plain loop below finishes any tail the cache window allows
+
         # Ctrl-C mid-loop returns what was generated so far
         # (≡ catch_loop_errors clean shutdown, context_managers.py:16-57)
         with catch_loop_errors() as guard:
-            while n < max_new_tokens and not all(done):
+            while n < max_new_tokens and not all(done) and not stats.interrupted:
                 room = self.max_seq_length - int(positions.max()) - 1
                 k = min(chunk_size, max_new_tokens - n, room)
                 if k < 1:
@@ -305,7 +385,7 @@ class Generator:
                 tok = toks_np[-1]
                 positions = positions + k
 
-        stats.interrupted = guard.interrupted
+        stats.interrupted = stats.interrupted or guard.interrupted
         stats.decode_s = time.perf_counter() - t_dec
         stats.tokens_generated = sum(len(o) - l for o, l in zip(out, lens))
 

@@ -155,3 +155,48 @@ def test_chat_cli_scripted(tiny_ckpt, monkeypatch, capsys):
     chat.main(["--ckpt", str(tiny_ckpt), "--dtype", "float32", "--n-tokens", "5"])
     out = capsys.readouterr().out
     assert "Chatting with" in out
+
+
+def test_chat_cli_two_turns_then_eof(tiny_ckpt, monkeypatch, capsys):
+    """Drive the chat REPL with scripted stdin (two turns then EOF)."""
+    from mdi_llm_tpu.cli import chat
+
+    lines = iter(["the quick brown", "fox jumps over"])
+
+    def fake_input(prompt=""):
+        try:
+            return next(lines)
+        except StopIteration:
+            raise EOFError
+
+    monkeypatch.setattr("builtins.input", fake_input)
+    rc = chat.main(
+        ["--ckpt", str(tiny_ckpt), "--dtype", "float32", "--n-tokens", "6",
+         "--temperature", "0.0"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Chatting with" in out
+
+
+def test_starter_debug_writes_role_log(tiny_ckpt, tmp_path):
+    import json as _json
+    import logging
+
+    from mdi_llm_tpu.cli.starter import main as starter_main
+
+    cfg_p = tmp_path / "standalone.json"
+    cfg_p.write_text(_json.dumps({"nodes": {"starter": {"addr": "127.0.0.1",
+        "communication": {"port": 1}}, "secondary": []}}))
+    try:
+        starter_main(
+            ["--ckpt", str(tiny_ckpt), "--dtype", "float32", "--nodes-config",
+             str(cfg_p), "--n-tokens", "4", "--prompt", "the quick", "--debug",
+             "--logs-dir", str(tmp_path / "logs"), "--pipeline-stages", "1"]
+        )
+    finally:
+        # drop the file handler so later tests don't write here
+        log = logging.getLogger("mdi_llm_tpu")
+        for h in list(log.handlers):
+            log.removeHandler(h)
+    assert (tmp_path / "logs" / "logs_starter.log").exists()

@@ -80,3 +80,38 @@ def test_sequence_length_guard(small_model):
     gen = Generator(cfg, params, max_seq_length=16, cache_dtype=jnp.float32)
     with pytest.raises(ValueError, match="exceeds max_seq_length"):
         gen.generate([[1] * 10], 20)
+
+
+def test_speculative_matches_plain_greedy():
+    """Speculative decoding must be token-identical to plain greedy decode,
+    across accept/reject mixes (repetitive prompt -> long accepts; random
+    tail -> rejects) and window-edge fallback."""
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(11), dtype=jnp.float32)
+    # repetitive prompt so n-gram lookup actually drafts
+    prompt = [5, 9, 2, 7, 5, 9, 2, 7, 5, 9, 2, 7, 3]
+
+    plain = Generator(cfg, params, rng_seed=3)
+    spec = Generator(cfg, params, rng_seed=3)
+    for n_tokens in (5, 17, 40):
+        o1, _ = plain.generate([prompt], n_tokens, temperature=0.0, chunk_size=4)
+        o2, s2 = spec.generate([prompt], n_tokens, temperature=0.0, speculative=4)
+        assert o1 == o2, f"n_tokens={n_tokens}: speculative diverged"
+        assert not s2.interrupted
+
+    with pytest.raises(ValueError):
+        spec.generate([prompt], 5, temperature=0.8, speculative=4)
+    with pytest.raises(ValueError):
+        spec.generate([prompt, prompt], 5, temperature=0.0, speculative=4)
+
+
+def test_ngram_draft_lookup():
+    from mdi_llm_tpu.generation import ngram_draft
+
+    toks = [1, 2, 3, 9, 8, 1, 2, 3, 4, 5, 6, 1, 2, 3]
+    # trailing [1,2,3] last occurred at index 5 -> followed by 4,5,6,...
+    assert ngram_draft(toks, 3) == [4, 5, 6]
+    assert ngram_draft(toks, 10) == [4, 5, 6, 1, 2, 3]
+    assert ngram_draft([1, 2], 4) == []
+    # latest earlier occurrence of [7,7] starts at index 2; only one token follows
+    assert ngram_draft([7, 7, 7, 7, 7], 2, ngram=2) == [7]

@@ -64,6 +64,9 @@ def test_model_surgery_set_and_dry_run(saved_ckpt, capsys):
     with pytest.raises(SystemExit):
         main(["--ckpt", str(saved_ckpt), "--set", "nonsense_field=1"])
 
+    # restore for later tests sharing the module-scoped fixture
+    main(["--ckpt", str(saved_ckpt), "--set", "block_size=64"])
+
 
 def test_convert_to_hf_roundtrip(saved_ckpt, tmp_path):
     from mdi_llm_tpu.cli.convert_to_hf import main
@@ -193,3 +196,26 @@ def test_generator_interrupt_returns_partial():
     )
     assert 3 <= len(outs[0]) - 3 < 20  # partial, not full
     assert stats.interrupted
+
+
+def test_evaluate_cli(saved_ckpt, tmp_path, capsys):
+    import json
+
+    from mdi_llm_tpu.cli.evaluate import main
+
+    rng = np.random.default_rng(0)
+    data_dir = tmp_path / "bins"
+    data_dir.mkdir()
+    for split, n in (("train", 4096), ("val", 2048)):
+        rng.integers(0, 96, n).astype(np.uint16).tofile(data_dir / f"{split}.bin")
+
+    rc = main([
+        "--ckpt", str(saved_ckpt), "--dataset", str(data_dir), "--split", "val",
+        "--eval-iters", "2", "--batch-size", "2", "--block-size", "32",
+        "--dtype", "float32",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    # random tokens vs random-ish weights: loss near ln(96)
+    assert 2.0 < rec["loss"] < 8.0
+    assert rec["perplexity"] > 1.0 and rec["split"] == "val"
