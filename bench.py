@@ -36,6 +36,7 @@ def main():
     ap.add_argument("--pipeline", type=int, default=0, help="run N-stage pipeline engine")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--quantize", choices=("none", "int8"), default="none")
+    ap.add_argument("--kv-dtype", choices=("auto", "bfloat16", "float16", "float32", "float8"), default="auto")
     ap.add_argument("--chunk", type=int, default=128, help="decode steps per jit call")
     args = ap.parse_args()
 
@@ -43,6 +44,8 @@ def main():
     from mdi_llm_tpu.models import transformer
 
     dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[args.dtype]
+    from mdi_llm_tpu.cli._common import resolve_kv_dtype
+    kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
     cfg = Config.from_name(args.model)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
     rng = np.random.default_rng(0)
@@ -59,14 +62,14 @@ def main():
             params,
             n_stages=args.pipeline,
             max_seq_length=args.seq_len,
-            cache_dtype=dtype,
+            cache_dtype=kv_dtype,
         )
         label = f"pipeline{args.pipeline}"
     else:
         from mdi_llm_tpu.generation import Generator
 
         engine = Generator(
-            cfg, params, max_seq_length=args.seq_len, cache_dtype=dtype,
+            cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
             quantize=args.quantize,
         )
         label = "batched-decode" + ("+int8" if args.quantize == "int8" else "")

@@ -26,6 +26,14 @@ from mdi_llm_tpu.utils.prompts import (
 from mdi_llm_tpu.utils.tokenizer import Tokenizer
 
 DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
+# KV-cache dtypes: the cache is written with a cast and upcast at the read
+# (ops/attention.py), so it may be narrower than the compute dtype
+KV_DTYPES = {**DTYPES, "float8": jnp.float8_e4m3fn}
+
+
+def resolve_kv_dtype(name: str):
+    """Map --kv-dtype to a jnp dtype; "auto" → None (follow the weights)."""
+    return None if name == "auto" else KV_DTYPES[name]
 
 
 def add_common_args(ap: argparse.ArgumentParser) -> None:
@@ -44,6 +52,13 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
         choices=("none", "int8"),
         default="none",
         help="weight-only quantization (int8 halves HBM traffic per decode step)",
+    )
+    ap.add_argument(
+        "--kv-dtype",
+        choices=("auto", *KV_DTYPES),
+        default="auto",
+        help="KV-cache storage dtype (float8 halves cache HBM traffic; "
+        "reads upcast to the compute dtype)",
     )
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--debug", action="store_true")
@@ -123,11 +138,15 @@ def setup_logging(args, role: str = None) -> logging.Logger:
     if args.debug and role:
         logs_dir = Path(getattr(args, "logs_dir", None) or "logs")
         logs_dir.mkdir(parents=True, exist_ok=True)
+        import os
+
         path = logs_dir / f"logs_{role}.log"
-        # idempotent: repeat calls (retries, tests) must not stack handlers
+        # idempotent: repeat calls (retries, tests) must not stack handlers.
+        # FileHandler stores os.path.abspath (symlinks unresolved) — compare
+        # apples to apples.
         for h in list(log.handlers):
-            if isinstance(h, logging.FileHandler) and h.baseFilename == str(
-                path.resolve()
+            if isinstance(h, logging.FileHandler) and h.baseFilename == os.path.abspath(
+                path
             ):
                 h.close()
                 log.removeHandler(h)

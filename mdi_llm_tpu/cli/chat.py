@@ -16,6 +16,7 @@ import numpy as np
 from mdi_llm_tpu.cli._common import (
     add_common_args,
     load_model,
+    resolve_kv_dtype,
     select_device,
     setup_logging,
 )
@@ -43,7 +44,7 @@ def main(argv=None):
     stop_seqs = prompt_style.stop_tokens(tokenizer)
     gen = Generator(
         cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed,
-        quantize=args.quantize,
+        quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
     )
 
     print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")

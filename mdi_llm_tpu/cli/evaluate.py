@@ -58,6 +58,11 @@ def main(argv=None):
     )
     bin_path = args.dataset / f"{args.split}.bin"
     data = data_loader.open_bin(bin_path)
+    if len(data) <= block_size + 1:
+        raise SystemExit(
+            f"{bin_path} holds {len(data)} tokens — need more than "
+            f"block_size+1 = {block_size + 1} (pass a smaller --block-size)"
+        )
     rng = np.random.default_rng(args.seed)
     losses = []
     for _ in range(args.eval_iters):

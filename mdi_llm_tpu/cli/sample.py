@@ -27,6 +27,7 @@ from mdi_llm_tpu.cli._common import (
     add_run_args,
     load_model,
     report_run,
+    resolve_kv_dtype,
     select_device,
     setup_logging,
 )
@@ -106,6 +107,7 @@ def main(argv=None):
             engine = PipelineEngine(
                 cfg, params, n_stages=args.pipeline_stages, max_seq_length=seq_len,
                 rng_seed=args.seed, quantize=args.quantize,
+                cache_dtype=resolve_kv_dtype(args.kv_dtype),
             )
             n_nodes = args.pipeline_stages
             outs, stats = engine.generate(
@@ -117,7 +119,7 @@ def main(argv=None):
 
             engine = Generator(
                 cfg, params, max_seq_length=seq_len, rng_seed=args.seed,
-                quantize=args.quantize,
+                quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
             )
             n_nodes = 1
             outs, stats = engine.generate(

@@ -35,6 +35,7 @@ from mdi_llm_tpu.cli._common import (
     add_run_args,
     load_model,
     report_run,
+    resolve_kv_dtype,
     select_device,
     setup_logging,
 )
@@ -104,6 +105,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
             seed=args.seed,
             dtype=args.dtype,
             quantize=args.quantize,
+            kv_dtype=args.kv_dtype,
             seq_len=args.sequence_length,
             # shape-critical: every process must build the identical SPMD ring
             n_stages=(
@@ -131,6 +133,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         max_seq_length=spec["seq_len"],
         rng_seed=spec["seed"],
         quantize=spec["quantize"],
+        cache_dtype=resolve_kv_dtype(spec["kv_dtype"]),
     )
     t0 = time.perf_counter()
     outs, stats = engine.generate(

@@ -339,6 +339,34 @@ class Generator:
                     and self.max_seq_length - int(positions[0]) - 1 >= K + 1
                 ):
                     draft = ngram_draft(out[0], K)
+                    if not draft:
+                        # no lookup match: a (K+1)-wide verify would burn
+                        # (K+1)x the step cost to emit one token — run a
+                        # plain chunked burst instead and retry drafting
+                        c = min(
+                            chunk_size,
+                            max_new_tokens - n,
+                            self.max_seq_length - int(positions[0]) - 1,
+                        )
+                        toks_j, kv, self.key = self._decode_chunk_fn(1, c)(
+                            self.params,
+                            jnp.asarray(tok, jnp.int32),
+                            kv,
+                            jnp.asarray(positions),
+                            self.key,
+                            temperature=0.0,
+                            top_k=top_k,
+                            top_p=top_p,
+                        )
+                        toks_np = np.asarray(toks_j)
+                        for i in range(c):
+                            n += 1
+                            emit(toks_np[i], n)
+                            if done[0]:
+                                break
+                        tok = toks_np[-1]
+                        positions = positions + c
+                        continue
                     draft = (list(draft) + [0] * K)[:K]
                     toks_in = np.asarray([[int(tok[0])] + draft], np.int32)
                     g, kv = self._verify_fn(K + 1)(

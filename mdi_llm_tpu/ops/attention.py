@@ -43,6 +43,9 @@ def multihead_attention(
     _, n_groups, Tk, _ = k.shape
     if scale is None:
         scale = 1.0 / (hs**0.5)
+    if k.dtype != q.dtype:  # narrow KV cache (e.g. fp8): upcast at the read
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
 
     q_per_kv = n_head // n_groups
     # fold the query heads into groups: (B, G, q_per_kv, Tq, hs)

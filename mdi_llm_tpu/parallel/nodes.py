@@ -108,17 +108,41 @@ def parse_nodes_config(path) -> NodesConfig:
     )
 
 
-def init_distributed(cfg: NodesConfig, process_id: int) -> None:
+def init_distributed(
+    cfg: NodesConfig, process_id: int, retries: int = 5, backoff_s: float = 2.0
+) -> None:
     """Join the job as process `process_id` (starter=0, secondary i → i+1).
     No-op for single-node configs (≡ standalone.json, gptserver.py:276-278).
+
+    Bounded retries with backoff ≡ the reference's HTTP-init retry loop
+    (`model_dist.py:499-573`, ≤100 tries / 2 s) — a secondary launched
+    before the starter's coordinator port is up should wait, not die.
     """
     if cfg.n_nodes == 1:
         return
-    jax.distributed.initialize(
-        coordinator_address=cfg.coordinator,
-        num_processes=cfg.n_nodes,
-        process_id=process_id,
-    )
+    import logging
+    import time
+
+    log = logging.getLogger("mdi_llm_tpu")
+    for attempt in range(retries):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator,
+                num_processes=cfg.n_nodes,
+                process_id=process_id,
+            )
+            return
+        except Exception as e:  # noqa: BLE001 — grpc surfaces various types
+            if attempt == retries - 1:
+                raise
+            log.warning(
+                "distributed init attempt %d/%d failed (%s); retrying in %.0fs",
+                attempt + 1,
+                retries,
+                e,
+                backoff_s,
+            )
+            time.sleep(backoff_s)
 
 
 def check_params_consistency(params, rtol: float = 1e-3) -> None:

@@ -135,3 +135,25 @@ def test_moe_quantized_forward():
     # int8 noise is small relative to logit scale
     denom = np.maximum(np.abs(np.asarray(lg32)), 1.0)
     assert np.max(np.abs(np.asarray(lg8) - np.asarray(lg32)) / denom) < 0.15
+
+
+def test_fp8_kv_cache_generation():
+    """float8 KV cache: runs end to end, early greedy tokens match the f32
+    cache (fp8 noise accumulates slowly at tiny scale)."""
+    cfg = tiny_cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(6), dtype=jnp.float32)
+    prompts = [[5, 9, 2, 7, 1]]
+    g32 = Generator(cfg, params, rng_seed=2)
+    g8 = Generator(cfg, params, rng_seed=2, cache_dtype=jnp.float8_e4m3fn)
+    o32, _ = g32.generate(prompts, 8, temperature=0.0)
+    o8, s8 = g8.generate(prompts, 8, temperature=0.0)
+    assert len(o8[0]) == len(prompts[0]) + 8
+    assert o8[0][: len(prompts[0]) + 2] == o32[0][: len(prompts[0]) + 2]
+
+
+def test_resolve_kv_dtype():
+    from mdi_llm_tpu.cli._common import resolve_kv_dtype
+
+    assert resolve_kv_dtype("auto") is None
+    assert resolve_kv_dtype("float8") == jnp.float8_e4m3fn
+    assert resolve_kv_dtype("bfloat16") == jnp.bfloat16
