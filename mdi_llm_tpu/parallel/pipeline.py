@@ -93,6 +93,7 @@ class PipelineEngine:
         rng_seed: int = 1337,
         devices: Optional[Sequence] = None,
         quantize: Optional[str] = None,  # None | "int8" (weight-only)
+        samples_per_slot: int = 1,  # M: samples traveling together per ring slot
     ):
         if quantize == "int8":
             from mdi_llm_tpu.ops.quant import quantize_params
@@ -133,6 +134,14 @@ class PipelineEngine:
         rope = transformer.get_rope_cache(cfg)
         self.rope = tuple(jax.device_put(np.asarray(r), repl_sh) for r in rope)
 
+        # M > 1 generalizes the reference's one-sample-per-node economics
+        # (README.md:33-37: full utilization needs n_samples >= n_nodes):
+        # each ring slot carries M samples batched through the stage's
+        # blocks, so full utilization yields S*M concurrent samples and the
+        # stage weights are read once per M samples per micro-step.
+        self.M = int(samples_per_slot)
+        if self.M < 1:
+            raise ValueError("samples_per_slot must be >= 1")
         self.n_slots = S + 1  # one cache slot per ring position + dummy
         # Multi-node jobs (cli/starter.py + cli/secondary.py): every process
         # must be able to read the emitted tokens, so the ring all-gathers
@@ -150,6 +159,7 @@ class PipelineEngine:
             self.n_stages,
             self.l_max,
             self.n_slots,
+            self.M,
             self.cfg.n_query_groups,
             self.max_seq_length,
             self.cfg.head_size,
@@ -162,12 +172,12 @@ class PipelineEngine:
 
     def _init_payload(self, T: int, dtype):
         sh = NamedSharding(self.mesh, P("pipe"))
-        S = self.n_stages
+        S, M = self.n_stages, self.M
         return {
-            "x": jax.device_put(jnp.zeros((S, T, self.cfg.n_embd), dtype), sh),
+            "x": jax.device_put(jnp.zeros((S, M, T, self.cfg.n_embd), dtype), sh),
             "sid": jax.device_put(jnp.full((S, 1), self.n_slots - 1, jnp.int32), sh),
-            "pos": jax.device_put(jnp.zeros((S, 1), jnp.int32), sh),
-            "valid": jax.device_put(jnp.zeros((S, 1), jnp.int32), sh),
+            "pos": jax.device_put(jnp.zeros((S, M), jnp.int32), sh),
+            "valid": jax.device_put(jnp.zeros((S, M), jnp.int32), sh),
         }
 
     # ------------------------------------------------------------------
