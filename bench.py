@@ -34,7 +34,11 @@ def main():
     ap.add_argument("--new-tokens", type=int, default=512)
     ap.add_argument("--seq-len", type=int, default=1024)
     ap.add_argument("--pipeline", type=int, default=0, help="run N-stage pipeline engine")
-    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument(
+        "--samples-per-slot", type=int, default=1,
+        help="pipeline mode: samples batched per ring slot (M)",
+    )
+    ap.add_argument("--dtype", choices=("bfloat16", "float16", "float32"), default="bfloat16")
     ap.add_argument("--quantize", choices=("none", "int8"), default="none")
     ap.add_argument("--kv-dtype", choices=("auto", "bfloat16", "float16", "float32", "float8"), default="auto")
     ap.add_argument("--chunk", type=int, default=128, help="decode steps per jit call")
@@ -43,7 +47,11 @@ def main():
     from mdi_llm_tpu.config import Config
     from mdi_llm_tpu.models import transformer
 
-    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[args.dtype]
+    dtype = {
+        "bfloat16": jnp.bfloat16,
+        "float16": jnp.float16,
+        "float32": jnp.float32,
+    }[args.dtype]
     from mdi_llm_tpu.cli._common import resolve_kv_dtype
     kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
     cfg = Config.from_name(args.model)
@@ -63,8 +71,12 @@ def main():
             n_stages=args.pipeline,
             max_seq_length=args.seq_len,
             cache_dtype=kv_dtype,
+            quantize=args.quantize,
+            samples_per_slot=args.samples_per_slot,
         )
-        label = f"pipeline{args.pipeline}"
+        label = f"pipeline{args.pipeline}" + (
+            f"xM{args.samples_per_slot}" if args.samples_per_slot > 1 else ""
+        ) + ("+int8" if args.quantize == "int8" else "")
     else:
         from mdi_llm_tpu.generation import Generator
 

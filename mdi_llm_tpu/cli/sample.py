@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(single sample, temperature 0; exact)",
     )
     ap.add_argument("--pipeline-stages", type=int, default=0)
+    ap.add_argument(
+        "--samples-per-slot",
+        type=int,
+        default=1,
+        help="pipeline mode: samples batched per ring slot (M)",
+    )
     # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
     ap.add_argument("--process-id", type=int, default=None)
@@ -108,6 +114,7 @@ def main(argv=None):
                 cfg, params, n_stages=args.pipeline_stages, max_seq_length=seq_len,
                 rng_seed=args.seed, quantize=args.quantize,
                 cache_dtype=resolve_kv_dtype(args.kv_dtype),
+                samples_per_slot=args.samples_per_slot,
             )
             n_nodes = args.pipeline_stages
             outs, stats = engine.generate(

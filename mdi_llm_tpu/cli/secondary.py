@@ -40,6 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
     # accepted for launch-script symmetry with cli/starter.py; the effective
     # value always comes from the starter's broadcast run spec
     ap.add_argument("--pipeline-stages", type=int, default=None)
+    ap.add_argument("--samples-per-slot", type=int, default=1)
     return ap
 
 

@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="stages to split over (default: one per chip in the job)",
     )
+    ap.add_argument(
+        "--samples-per-slot",
+        type=int,
+        default=1,
+        help="samples batched per ring slot (M): full utilization serves "
+        "stages×M concurrent samples",
+    )
     return ap
 
 
@@ -113,6 +120,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
                 or nodes_cfg.pipeline_stages
                 or jax.device_count()
             ),
+            samples_per_slot=args.samples_per_slot,
         )
         spec = broadcast_run_spec(spec)
     else:
@@ -134,6 +142,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         rng_seed=spec["seed"],
         quantize=spec["quantize"],
         cache_dtype=resolve_kv_dtype(spec["kv_dtype"]),
+        samples_per_slot=spec.get("samples_per_slot", 1),
     )
     t0 = time.perf_counter()
     outs, stats = engine.generate(

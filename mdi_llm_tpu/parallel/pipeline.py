@@ -185,23 +185,27 @@ class PipelineEngine:
     # ------------------------------------------------------------------
 
     def _run_stage_blocks(self, blocks, rope, kv_k, kv_v, x, sid, input_pos):
-        """Run the local (padded) block stack on x (T, D) using cache slot
-        `sid` at offset `input_pos` (scalars); returns (x_out, kv_k, kv_v)."""
+        """Run the local (padded) block stack on x (M, T, D) — the M samples
+        sharing ring slot `sid` (scalar) — with per-sample cache offsets
+        `input_pos` (M,).  kv_k/kv_v are the stage's full cache
+        (l_max, n_slots, M, G, seq, hs); returns (x_out, kv_k, kv_v)."""
         cfg = self.cfg
-        T = x.shape[0]
-        xb = x[None]  # (1, T, D)
-        ip = input_pos.reshape(1)
-        pos = ip[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        T = x.shape[1]
+        pos = input_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # (M, T)
         cos = jnp.take(rope[0], pos, axis=0)
         sin = jnp.take(rope[1], pos, axis=0)
-        k_slot = jax.lax.dynamic_slice_in_dim(kv_k, sid, 1, axis=1)
-        v_slot = jax.lax.dynamic_slice_in_dim(kv_v, sid, 1, axis=1)
+        k_slot = jax.lax.dynamic_slice_in_dim(kv_k, sid, 1, axis=1)[:, 0]
+        v_slot = jax.lax.dynamic_slice_in_dim(kv_v, sid, 1, axis=1)[:, 0]
         x_out, kv_new = transformer.run_blocks(
-            cfg, blocks, xb, pos, cos, sin, {"k": k_slot, "v": v_slot}, ip
+            cfg, blocks, x, pos, cos, sin, {"k": k_slot, "v": v_slot}, input_pos
         )
-        kv_k = jax.lax.dynamic_update_slice_in_dim(kv_k, kv_new["k"], sid, axis=1)
-        kv_v = jax.lax.dynamic_update_slice_in_dim(kv_v, kv_new["v"], sid, axis=1)
-        return x_out[0], kv_k, kv_v
+        kv_k = jax.lax.dynamic_update_slice_in_dim(
+            kv_k, kv_new["k"][:, None], sid, axis=1
+        )
+        kv_v = jax.lax.dynamic_update_slice_in_dim(
+            kv_v, kv_new["v"][:, None], sid, axis=1
+        )
+        return x_out, kv_k, kv_v
 
     # ------------------------------------------------------------------
     # jitted phases
@@ -220,11 +224,12 @@ class PipelineEngine:
         return self._decode_jit[key]
 
     def _build_prefill(self, W: int, T: int, temperature, top_k, top_p):
-        cfg, S, mesh = self.cfg, self.n_stages, self.mesh
+        """W = number of slot groups (each carrying M samples)."""
+        cfg, S, M, mesh = self.cfg, self.n_stages, self.M, self.mesh
         n_steps = W + S
         dummy = self.n_slots - 1
 
-        def ring(blocks, head, rope, kv, payload, prompts, lens, key):
+        def ring(blocks, head, rope, kv, payload, prompts, lens, gvalid, key):
             stage = jax.lax.axis_index("pipe")
             perm = [(i, (i + 1) % S) for i in range(S)]
             # strip the local stage axis (size 1) from the sharded operands
@@ -232,44 +237,48 @@ class PipelineEngine:
 
             def body(carry, step):
                 kv_k, kv_v, x, sid, pos, valid, key = carry
-                sid0, pos0, val0 = sid[0], pos[0], valid[0]
+                sid0, pos0, val0 = sid[0], pos, valid  # (), (M,), (M,)
 
                 # ---- stage 0: head + first-token sample on the returning
-                # activation (gather the last valid position) ----
-                x_ret = jax.lax.dynamic_slice_in_dim(
-                    x, jnp.maximum(pos0 - 1, 0), 1, axis=0
-                )  # (1, D)
-                logits = transformer.head(cfg, head, x_ret[None])[0, 0]  # (V,)
+                # activations (gather each sample's last valid position) ----
+                idx = jnp.clip(pos0 - 1, 0, T - 1)  # (M,)
+                x_ret = jnp.take_along_axis(x, idx[:, None, None], axis=1)
+                logits = transformer.head(cfg, head, x_ret)[:, 0]  # (M, V)
                 key, sub = jax.random.split(key)
                 tok = sample(
-                    logits[None], sub, temperature=temperature, top_k=top_k, top_p=top_p
-                )[0].astype(jnp.int32)
-                emit = (tok.reshape(1), sid0.reshape(1), val0.reshape(1))
+                    logits, sub, temperature=temperature, top_k=top_k, top_p=top_p
+                ).astype(jnp.int32)  # (M,)
+                emit = (tok, sid0.reshape(1), val0)
 
-                # ---- stage 0: inject prompt `step` into the ring ----
+                # ---- stage 0: inject prompt group `step` into the ring ----
                 inj_valid = (step < W).astype(jnp.int32)
                 inj_idx = jnp.minimum(step, W - 1)
-                inj_tokens = jax.lax.dynamic_slice_in_dim(prompts, inj_idx, 1, axis=0)
-                pos_grid = jnp.arange(T, dtype=jnp.int32)[None, :]
-                emb = transformer.embed(cfg, head, inj_tokens, pos_grid)[0]
+                inj_tokens = jax.lax.dynamic_slice_in_dim(
+                    prompts, inj_idx, 1, axis=0
+                )[0]  # (M, T)
+                pos_grid = jnp.broadcast_to(
+                    jnp.arange(T, dtype=jnp.int32)[None, :], (M, T)
+                )
+                emb = transformer.embed(cfg, head, inj_tokens, pos_grid)  # (M,T,D)
+                g_lens = jax.lax.dynamic_slice_in_dim(lens, inj_idx, 1, axis=0)[0]
+                g_val = jax.lax.dynamic_slice_in_dim(gvalid, inj_idx, 1, axis=0)[0]
 
                 is0 = stage == 0
                 x_proc = jnp.where(is0, emb.astype(x.dtype), x)
                 sid_proc = jnp.where(
                     is0, jnp.where(inj_valid == 1, inj_idx, dummy), sid0
                 )
-                len_proc = jnp.where(
-                    is0, jax.lax.dynamic_slice_in_dim(lens, inj_idx, 1)[0], pos0
-                )
-                val_proc = jnp.where(is0, inj_valid, val0)
+                pos_proc = jnp.where(is0, g_lens, pos0)
+                val_proc = jnp.where(is0, g_val * inj_valid, val0)
 
                 x_out, kv_k, kv_v = self._run_stage_blocks(
-                    blocks, rope, kv_k, kv_v, x_proc, sid_proc, jnp.int32(0)
+                    blocks, rope, kv_k, kv_v, x_proc, sid_proc,
+                    jnp.zeros((M,), jnp.int32),
                 )
                 x_n = jax.lax.ppermute(x_out, "pipe", perm)
                 sid_n = jax.lax.ppermute(sid_proc.reshape(1), "pipe", perm)
-                pos_n = jax.lax.ppermute(len_proc.reshape(1), "pipe", perm)
-                val_n = jax.lax.ppermute(val_proc.reshape(1), "pipe", perm)
+                pos_n = jax.lax.ppermute(pos_proc, "pipe", perm)
+                val_n = jax.lax.ppermute(val_proc, "pipe", perm)
                 return (kv_k, kv_v, x_n, sid_n, pos_n, val_n, key), emit
 
             carry = (
@@ -306,6 +315,7 @@ class PipelineEngine:
                 repl,
                 repl,
                 repl,
+                repl,
             ),
             out_specs=(
                 {"k": pipe, "v": pipe},
@@ -323,28 +333,29 @@ class PipelineEngine:
             perm = [(i, (i + 1) % S) for i in range(S)]
             blocks = jax.tree_util.tree_map(lambda a: a[0], blocks)
 
-            def body(carry, step_in):
+            def body(carry, ov):
                 kv_k, kv_v, x, sid, pos, valid, key = carry
-                ov_flag, ov_sid, ov_tok, ov_pos = step_in
-                sid0, pos0, val0 = sid[0], pos[0], valid[0]
+                sid0, pos0, val0 = sid[0], pos, valid  # (), (M,), (M,)
 
-                # stage 0: head + sample on the returning activation (T=1)
-                logits = transformer.head(cfg, head, x[None])[0, -1]  # (V,)
+                # stage 0: head + sample on the returning activations (T=1)
+                logits = transformer.head(cfg, head, x)[:, -1]  # (M, V)
                 key, sub = jax.random.split(key)
                 tok = sample(
-                    logits[None], sub, temperature=temperature, top_k=top_k, top_p=top_p
-                )[0].astype(jnp.int32)
-                emit = (tok.reshape(1), sid0.reshape(1), val0.reshape(1))
+                    logits, sub, temperature=temperature, top_k=top_k, top_p=top_p
+                ).astype(jnp.int32)  # (M,)
+                emit = (tok, sid0.reshape(1), val0)
 
-                use_ov = ov_flag == 1
-                tok_sel = jnp.where(use_ov, ov_tok, tok)
-                sid_sel = jnp.where(use_ov, ov_sid, sid0)
-                pos_sel = jnp.where(use_ov, ov_pos, pos0 + 1)
-                val_sel = jnp.where(use_ov, jnp.int32(1), val0)
+                # per-sample override lanes (seed a slot after prefill, or
+                # feed the next queued prompt's tokens into a freed lane)
+                use_ov = ov["flag"] == 1  # (M,)
+                tok_sel = jnp.where(use_ov, ov["tok"], tok)
+                pos_sel = jnp.where(use_ov, ov["pos"], pos0 + 1)
+                val_sel = jnp.where(use_ov, ov["val"], val0)
+                sid_sel = jnp.where(jnp.any(use_ov), ov["sid"], sid0)
 
                 emb = transformer.embed(
-                    cfg, head, tok_sel.reshape(1, 1), pos_sel.reshape(1, 1)
-                )[0]  # (1, D)
+                    cfg, head, tok_sel[:, None], pos_sel[:, None]
+                )  # (M, 1, D)
 
                 is0 = stage == 0
                 x_proc = jnp.where(is0, emb.astype(x.dtype), x)
@@ -357,8 +368,8 @@ class PipelineEngine:
                 )
                 x_n = jax.lax.ppermute(x_out, "pipe", perm)
                 sid_n = jax.lax.ppermute(sid_proc.reshape(1), "pipe", perm)
-                pos_n = jax.lax.ppermute(pos_proc.reshape(1), "pipe", perm)
-                val_n = jax.lax.ppermute(val_proc.reshape(1), "pipe", perm)
+                pos_n = jax.lax.ppermute(pos_proc, "pipe", perm)
+                val_n = jax.lax.ppermute(val_proc, "pipe", perm)
                 return (kv_k, kv_v, x_n, sid_n, pos_n, val_n, key), emit
 
             carry = (
@@ -423,14 +434,14 @@ class PipelineEngine:
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for n_samples prompts using recurrent
         pipeline parallelism.  Samples are processed in waves of up to
-        n_stages (the reference requires n_samples ≥ n_nodes for full
-        utilization, README.md:33-37; same economics here)."""
-        S = self.n_stages
+        n_stages × samples_per_slot (the reference requires n_samples ≥
+        n_nodes for full utilization, README.md:33-37; same economics)."""
+        cap = self.n_stages * self.M
         stats = GenerationStats()
         results: List[List[int]] = [[] for _ in prompts]
         t_all = time.perf_counter()
-        for wave_start in range(0, len(prompts), S):
-            wave = list(prompts[wave_start : wave_start + S])
+        for wave_start in range(0, len(prompts), cap):
+            wave = list(prompts[wave_start : wave_start + cap])
             outs = self._generate_wave(
                 wave, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
             )
@@ -444,11 +455,28 @@ class PipelineEngine:
         )
         return results, stats
 
+    def _stage0_emits(self, emits):
+        """Host view of one call's emissions: stage 0's tokens (R, M),
+        slot ids (R,), valid flags (R, M)."""
+        toks, sids, vals = (np.asarray(e) for e in emits)
+        return toks[:, : self.M], sids[:, 0], vals[:, : self.M]
+
+    def _empty_overrides(self):
+        S, M = self.n_stages, self.M
+        return {
+            "flag": np.zeros((S, M), np.int32),
+            "sid": np.full((S,), self.n_slots - 1, np.int32),
+            "tok": np.zeros((S, M), np.int32),
+            "pos": np.zeros((S, M), np.int32),
+            "val": np.zeros((S, M), np.int32),
+        }
+
     def _generate_wave(
         self, prompts, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
     ):
-        S = self.n_stages
-        W = len(prompts)
+        S, M = self.n_stages, self.M
+        Wn = len(prompts)  # samples in this wave, <= S*M
+        n_groups = -(-Wn // M)
         lens = [len(p) for p in prompts]
         if min(lens) < 1:
             raise ValueError("empty prompt")
@@ -459,16 +487,22 @@ class PipelineEngine:
             )
         Tb = _bucket(max(lens))
 
-        prompts_np = np.zeros((W, Tb), np.int32)
+        # pack samples into groups of M lanes; ragged tail lanes are invalid
+        prompts_np = np.zeros((n_groups, M, Tb), np.int32)
+        lens_np = np.ones((n_groups, M), np.int32)
+        valid_np = np.zeros((n_groups, M), np.int32)
         for i, p in enumerate(prompts):
-            prompts_np[i, : lens[i]] = np.asarray(p, np.int32)
+            g, m = divmod(i, M)
+            prompts_np[g, m, : lens[i]] = np.asarray(p, np.int32)
+            lens_np[g, m] = lens[i]
+            valid_np[g, m] = 1
 
         kv = self._init_kv()
         dtype = transformer.param_dtype(self.stage_blocks)
 
         # ---- phase 1: pipelined prefill ----
         t_p = time.perf_counter()
-        prefill = self._get_prefill(W, Tb, temperature, top_k, top_p)
+        prefill = self._get_prefill(n_groups, Tb, temperature, top_k, top_p)
         payload = self._init_payload(Tb, dtype)
         self.key, sub = jax.random.split(self.key)
         kv, emits = prefill(
@@ -478,19 +512,25 @@ class PipelineEngine:
             kv,
             payload,
             jnp.asarray(prompts_np),
-            jnp.asarray(lens, jnp.int32),
+            jnp.asarray(lens_np),
+            jnp.asarray(valid_np),
             sub,
         )
-        toks_e, sids_e, vals_e = (np.asarray(e)[:, 0] for e in emits)
-        first_tok = {
-            int(s): int(t) for t, s, v in zip(toks_e, sids_e, vals_e) if v and s < W
-        }
-        assert len(first_tok) == W, f"prefill returned {len(first_tok)}/{W} samples"
+        toks_e, sids_e, vals_e = self._stage0_emits(emits)
+        first_tok = {}
+        for t_row, s, v_row in zip(toks_e, sids_e, vals_e):
+            s = int(s)
+            if s < n_groups:
+                for m in range(M):
+                    j = s * M + m
+                    if v_row[m] and j < Wn:
+                        first_tok[j] = int(t_row[m])
+        assert len(first_tok) == Wn, f"prefill returned {len(first_tok)}/{Wn} samples"
         stats.prefill_s += time.perf_counter() - t_p
 
         out = [list(p) for p in prompts]
-        done = [False] * W
-        for j in range(W):
+        done = [False] * Wn
+        for j in range(Wn):
             out[j].append(first_tok[j])
             if detect_stop_tokens(out[j][lens[j] :], stop_sequences):
                 done[j] = True
@@ -500,11 +540,21 @@ class PipelineEngine:
         decode = self._get_decode(temperature, top_k, top_p)
         payload = self._init_payload(1, dtype)
 
-        # seeding rotation: inject sample j's first token at micro-step j
-        ov = np.zeros((S, 4), np.int32)
-        for j in range(W):
-            ov[j] = (1, j, first_tok[j], lens[j])
+        # seeding rotation: inject group g's first tokens at micro-step g
+        ov = self._empty_overrides()
+        for g in range(n_groups):
+            ov["flag"][g] = valid_np[g]
+            ov["sid"][g] = g
+            ov["pos"][g] = lens_np[g]
+            ov["val"][g] = valid_np[g]
+            for m in range(M):
+                j = g * M + m
+                if valid_np[g, m]:
+                    ov["tok"][g, m] = first_tok[j]
         seeded = False
+        ov_dev = {k: jnp.asarray(v) for k, v in ov.items()}
+        # empty overrides are constant: upload once, reuse every rotation
+        empty_dev = {k: jnp.asarray(v) for k, v in self._empty_overrides().items()}
         # Ctrl-C mid-ring returns partial results (single-process; in a
         # multi-process job an interrupt tears down the whole SPMD group)
         with catch_loop_errors() as guard:
@@ -518,21 +568,25 @@ class PipelineEngine:
                     self.rope,
                     kv,
                     payload,
-                    jnp.asarray(ov),
+                    ov_dev,
                     sub,
                 )
                 if not seeded:
                     # the seeding rotation emits only bubble payloads
-                    ov = np.zeros((S, 4), np.int32)
+                    ov_dev = empty_dev
                     seeded = True
                     continue
-                toks_e, sids_e, vals_e = (np.asarray(e)[:, 0] for e in emits)
-                for t, s, v in zip(toks_e, sids_e, vals_e):
+                toks_e, sids_e, vals_e = self._stage0_emits(emits)
+                for t_row, s, v_row in zip(toks_e, sids_e, vals_e):
                     s = int(s)
-                    if v and s < W and not done[s]:
-                        out[s].append(int(t))
-                        if detect_stop_tokens(out[s][lens[s] :], stop_sequences):
-                            done[s] = True
+                    if s >= n_groups:
+                        continue
+                    for m in range(M):
+                        j = s * M + m
+                        if v_row[m] and j < Wn and not done[j]:
+                            out[j].append(int(t_row[m]))
+                            if detect_stop_tokens(out[j][lens[j] :], stop_sequences):
+                                done[j] = True
                 n_tok += 1
                 stats.tok_time.append(
                     (sum(len(o) - l for o, l in zip(out, lens)), time.perf_counter() - t_all)

@@ -89,6 +89,39 @@ def test_pipeline_stop_sequences(model, single_engine, devices):
     assert got[0] == free[0][: len(PROMPTS[0]) + 3]
 
 
+@pytest.mark.parametrize("n_samples", [4, 3])
+def test_pipeline_samples_per_slot(model, single_engine, n_samples, devices):
+    """M > 1: each ring slot carries M samples batched through the stage
+    blocks (n_samples=3 leaves a ragged, invalid lane in the last group)."""
+    cfg, params = model
+    eng = PipelineEngine(
+        cfg,
+        params,
+        mesh=pipeline_mesh(2, devices[:2]),
+        cache_dtype=jnp.float32,
+        samples_per_slot=2,
+    )
+    want = _single(single_engine, PROMPTS[:n_samples], 8)
+    got, stats = eng.generate(PROMPTS[:n_samples], 8, temperature=0.0)
+    assert got == want
+    assert stats.tokens_generated == 8 * n_samples
+
+
+def test_pipeline_samples_per_slot_waves(model, single_engine, devices):
+    """n_samples > S*M still runs in waves."""
+    cfg, params = model
+    eng = PipelineEngine(
+        cfg,
+        params,
+        mesh=pipeline_mesh(1, devices[:1]),
+        cache_dtype=jnp.float32,
+        samples_per_slot=2,
+    )
+    want = _single(single_engine, PROMPTS, 6)
+    got, _ = eng.generate(PROMPTS, 6, temperature=0.0)
+    assert got == want
+
+
 def test_pipeline_gqa_variant(devices):
     cfg = tiny_config(block_size=64, n_layer=4, **CONFIG_VARIANTS["gqa"])
     params = init_params(cfg, jax.random.PRNGKey(3))
