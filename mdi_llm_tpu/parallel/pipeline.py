@@ -229,7 +229,7 @@ class PipelineEngine:
         n_steps = W + S
         dummy = self.n_slots - 1
 
-        def ring(blocks, head, rope, kv, payload, prompts, lens, gvalid, key):
+        def ring(blocks, head, rope, kv, payload, prompts, lens, gvalid, slot_ids, key):
             stage = jax.lax.axis_index("pipe")
             perm = [(i, (i + 1) % S) for i in range(S)]
             # strip the local stage axis (size 1) from the sharded operands
@@ -262,11 +262,12 @@ class PipelineEngine:
                 emb = transformer.embed(cfg, head, inj_tokens, pos_grid)  # (M,T,D)
                 g_lens = jax.lax.dynamic_slice_in_dim(lens, inj_idx, 1, axis=0)[0]
                 g_val = jax.lax.dynamic_slice_in_dim(gvalid, inj_idx, 1, axis=0)[0]
+                g_slot = jax.lax.dynamic_slice_in_dim(slot_ids, inj_idx, 1, axis=0)[0]
 
                 is0 = stage == 0
                 x_proc = jnp.where(is0, emb.astype(x.dtype), x)
                 sid_proc = jnp.where(
-                    is0, jnp.where(inj_valid == 1, inj_idx, dummy), sid0
+                    is0, jnp.where(inj_valid == 1, g_slot, dummy), sid0
                 )
                 pos_proc = jnp.where(is0, g_lens, pos0)
                 val_proc = jnp.where(is0, g_val * inj_valid, val0)
@@ -312,6 +313,7 @@ class PipelineEngine:
                 (repl, repl),
                 {"k": pipe, "v": pipe},
                 {"x": pipe, "sid": pipe, "pos": pipe, "valid": pipe},
+                repl,
                 repl,
                 repl,
                 repl,
@@ -433,22 +435,26 @@ class PipelineEngine:
         stop_sequences: Sequence[Sequence[int]] = (),
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for n_samples prompts using recurrent
-        pipeline parallelism.  Samples are processed in waves of up to
-        n_stages × samples_per_slot (the reference requires n_samples ≥
-        n_nodes for full utilization, README.md:33-37; same economics)."""
-        cap = self.n_stages * self.M
+        pipeline parallelism with continuous sample scheduling.
+
+        The first n_stages × samples_per_slot prompts are prefilled in
+        parallel and seeded onto the ring; whenever an in-flight sample
+        finishes (stop sequence or token budget), its lane is refilled with
+        the next queued prompt — the ring never idles while work remains,
+        reproducing the reference's round-robin sample scheduling
+        (`gptserver.py:912-1001`, README.md:33-37).  Fully-freed slots are
+        refilled by a pipelined parallel prefill call (refill latency is
+        generation-bound, not prompt-length-bound); only a free lane of a
+        partially-busy slot (samples_per_slot > 1) falls back to feeding its
+        prompt one token per rotation through the override channel."""
         stats = GenerationStats()
-        results: List[List[int]] = [[] for _ in prompts]
         t_all = time.perf_counter()
-        for wave_start in range(0, len(prompts), cap):
-            wave = list(prompts[wave_start : wave_start + cap])
-            outs = self._generate_wave(
-                wave, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
-            )
-            for i, o in enumerate(outs):
-                results[wave_start + i] = o
-            if stats.interrupted:
-                break  # Ctrl-C: skip remaining waves, return partials
+        if not prompts:
+            return [], stats
+        results = self._generate_continuous(
+            list(prompts), max_new_tokens, temperature, top_k, top_p,
+            stop_sequences, stats, t_all,
+        )
         stats.decode_s = time.perf_counter() - t_all - stats.prefill_s
         stats.tokens_generated = sum(
             len(o) - len(p) for o, p in zip(results, prompts)
@@ -471,12 +477,11 @@ class PipelineEngine:
             "val": np.zeros((S, M), np.int32),
         }
 
-    def _generate_wave(
+    def _generate_continuous(
         self, prompts, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
     ):
         S, M = self.n_stages, self.M
-        Wn = len(prompts)  # samples in this wave, <= S*M
-        n_groups = -(-Wn // M)
+        N = len(prompts)
         lens = [len(p) for p in prompts]
         if min(lens) < 1:
             raise ValueError("empty prompt")
@@ -485,82 +490,233 @@ class PipelineEngine:
                 f"prompt+generation length {max(lens) + max_new_tokens} exceeds "
                 f"max_seq_length {self.max_seq_length}"
             )
-        Tb = _bucket(max(lens))
 
-        # pack samples into groups of M lanes; ragged tail lanes are invalid
+        # ---- initial batch: first S*M samples, packed into groups of M ----
+        n_init = min(N, S * M)
+        n_groups = -(-n_init // M)
+        Tb = _bucket(max(lens[:n_init]))
         prompts_np = np.zeros((n_groups, M, Tb), np.int32)
         lens_np = np.ones((n_groups, M), np.int32)
         valid_np = np.zeros((n_groups, M), np.int32)
-        for i, p in enumerate(prompts):
+        for i in range(n_init):
             g, m = divmod(i, M)
-            prompts_np[g, m, : lens[i]] = np.asarray(p, np.int32)
+            prompts_np[g, m, : lens[i]] = np.asarray(prompts[i], np.int32)
             lens_np[g, m] = lens[i]
             valid_np[g, m] = 1
 
         kv = self._init_kv()
         dtype = transformer.param_dtype(self.stage_blocks)
 
-        # ---- phase 1: pipelined prefill ----
-        t_p = time.perf_counter()
-        prefill = self._get_prefill(n_groups, Tb, temperature, top_k, top_p)
-        payload = self._init_payload(Tb, dtype)
-        self.key, sub = jax.random.split(self.key)
-        kv, emits = prefill(
-            self.stage_blocks,
-            self.head_params,
-            self.rope,
-            kv,
-            payload,
-            jnp.asarray(prompts_np),
-            jnp.asarray(lens_np),
-            jnp.asarray(valid_np),
-            sub,
-        )
-        toks_e, sids_e, vals_e = self._stage0_emits(emits)
-        first_tok = {}
-        for t_row, s, v_row in zip(toks_e, sids_e, vals_e):
-            s = int(s)
-            if s < n_groups:
-                for m in range(M):
-                    j = s * M + m
-                    if v_row[m] and j < Wn:
-                        first_tok[j] = int(t_row[m])
-        assert len(first_tok) == Wn, f"prefill returned {len(first_tok)}/{Wn} samples"
-        stats.prefill_s += time.perf_counter() - t_p
-
         out = [list(p) for p in prompts]
-        done = [False] * Wn
-        for j in range(Wn):
-            out[j].append(first_tok[j])
-            if detect_stop_tokens(out[j][lens[j] :], stop_sequences):
+        done = [False] * N
+
+        def budget(j):
+            """Remaining tokens sample j may still emit."""
+            gen = len(out[j]) - lens[j]
+            return min(max_new_tokens - gen, self.max_seq_length - len(out[j]))
+
+        def run_prefill(p_np, l_np, v_np, slots_np):
+            """One pipelined-prefill call: process whole prompt groups at
+            once and return {sample_lane: first_token} keyed by (slot, m)."""
+            nonlocal kv
+            t_p = time.perf_counter()
+            W, _, T = p_np.shape
+            prefill = self._get_prefill(W, T, temperature, top_k, top_p)
+            self.key, sub = jax.random.split(self.key)
+            kv, emits = prefill(
+                self.stage_blocks,
+                self.head_params,
+                self.rope,
+                kv,
+                self._init_payload(T, dtype),
+                jnp.asarray(p_np),
+                jnp.asarray(l_np),
+                jnp.asarray(v_np),
+                jnp.asarray(slots_np),
+                sub,
+            )
+            toks_e, sids_e, vals_e = self._stage0_emits(emits)
+            firsts = {}
+            slot_set = set(int(s) for s in slots_np)
+            for t_row, s, v_row in zip(toks_e, sids_e, vals_e):
+                s = int(s)
+                if s in slot_set:
+                    for m in range(M):
+                        if v_row[m]:
+                            firsts[(s, m)] = int(t_row[m])
+            stats.prefill_s += time.perf_counter() - t_p
+            return firsts
+
+        # ---- phase 1: pipelined parallel prefill of the initial batch ----
+        firsts = run_prefill(
+            prompts_np, lens_np, valid_np, np.arange(n_groups, dtype=np.int32)
+        )
+        assert len(firsts) == n_init, f"prefill returned {len(firsts)}/{n_init}"
+
+        # scheduler state
+        queue = list(range(n_init, N))  # samples not yet on the ring
+        active: Dict[Tuple[int, int], int] = {}  # lane -> generating sample
+        filling: Dict[Tuple[int, int], List[int]] = {}  # lane -> [sample, next_idx]
+        # emissions arriving in call r were fed in call r-1: lane -> sample
+        fed_prev: Dict[Tuple[int, int], int] = {}
+        fed_cur: Dict[Tuple[int, int], int] = {}
+
+        for (g, m), tok in firsts.items():
+            j = g * M + m
+            out[j].append(tok)
+            if detect_stop_tokens(out[j][lens[j] :], stop_sequences) or budget(j) <= 0:
                 done[j] = True
-        n_tok = 1
+            else:
+                active[(g, m)] = j
 
-        # ---- phase 2: decode rotations ----
         decode = self._get_decode(temperature, top_k, top_p)
-        payload = self._init_payload(1, dtype)
-
-        # seeding rotation: inject group g's first tokens at micro-step g
-        ov = self._empty_overrides()
-        for g in range(n_groups):
-            ov["flag"][g] = valid_np[g]
-            ov["sid"][g] = g
-            ov["pos"][g] = lens_np[g]
-            ov["val"][g] = valid_np[g]
-            for m in range(M):
-                j = g * M + m
-                if valid_np[g, m]:
-                    ov["tok"][g, m] = first_tok[j]
-        seeded = False
-        ov_dev = {k: jnp.asarray(v) for k, v in ov.items()}
-        # empty overrides are constant: upload once, reuse every rotation
+        payload = None  # built by the first re-seed
+        # empty overrides are constant: upload once, reuse when nothing fills
         empty_dev = {k: jnp.asarray(v) for k, v in self._empty_overrides().items()}
+
+        def batch_refills():
+            """Parallel-prefill queued prompts into fully-free slots (whole
+            slots only: a prefill rewrites all M cache lanes of its slot).
+            Returns True if the ring must be re-seeded."""
+            busy_slots = {g for (g, m) in (*active, *filling)}
+            free = [g for g in range(S) if g not in busy_slots]
+            if not queue or not free:
+                return False
+            K = min(len(free), -(-len(queue) // M))
+            take = queue[: K * M]
+            del queue[: K * M]
+            Tb2 = _bucket(max(lens[j] for j in take))
+            # pad the group count to a power of two so refill prefills hit a
+            # bounded set of compiled shapes; padded groups are all-invalid
+            # and write only the dummy cache slot
+            Kp = 1 << (K - 1).bit_length()
+            p_np = np.zeros((Kp, M, Tb2), np.int32)
+            l_np = np.ones((Kp, M), np.int32)
+            v_np = np.zeros((Kp, M), np.int32)
+            slots_np = np.full((Kp,), self.n_slots - 1, np.int32)
+            slots_np[:K] = free[:K]
+            lane_of = {}
+            for i, j in enumerate(take):
+                k_, m = divmod(i, M)
+                p_np[k_, m, : lens[j]] = np.asarray(prompts[j], np.int32)
+                l_np[k_, m] = lens[j]
+                v_np[k_, m] = 1
+                lane_of[(free[k_], m)] = j
+            firsts = run_prefill(p_np, l_np, v_np, slots_np)
+            assert len(firsts) == len(take), (
+                f"refill prefill returned {len(firsts)}/{len(take)}"
+            )
+            for lane, tok in firsts.items():
+                j = lane_of[lane]
+                out[j].append(tok)
+                if (
+                    detect_stop_tokens(out[j][lens[j] :], stop_sequences)
+                    or budget(j) <= 0
+                ):
+                    done[j] = True
+                else:
+                    active[lane] = j
+            return True
+
+        def schedule_token_refills():
+            """Assign queued samples to free lanes of partially-busy slots;
+            their prompts are fed one token per rotation (fully-free slots
+            are handled by batch_refills)."""
+            if not queue:
+                return
+            busy = set(active) | set(filling)
+            for g in range(S):
+                n_busy = sum((g, m) in busy for m in range(M))
+                if n_busy == 0 or n_busy == M:
+                    continue
+                for m in range(M):
+                    if not queue:
+                        return
+                    if (g, m) not in busy:
+                        filling[(g, m)] = [queue.pop(0), 0]
+                        stats.token_fills += 1
+
+        def build_reseed_ov():
+            """After a prefill pause the ring payload is discarded; re-feed
+            every surviving lane's last token (KV rewrite is idempotent —
+            same values at the same positions) plus the refilled lanes'
+            first tokens, all in one seeding rotation."""
+            ov = self._empty_overrides()
+            fed = {}
+            for (g, m), j in active.items():
+                ov["flag"][g, m] = 1
+                ov["sid"][g] = g
+                ov["tok"][g, m] = out[j][-1]
+                ov["pos"][g, m] = len(out[j]) - 1
+                ov["val"][g, m] = 1
+                fed[(g, m)] = j
+            for (g, m), st in filling.items():
+                j, idx = st
+                ov["flag"][g, m] = 1
+                ov["sid"][g] = g
+                fed[(g, m)] = j
+                if idx == 0:
+                    # nothing fed yet: feed the first prompt token now
+                    ov["tok"][g, m] = prompts[j][0]
+                    ov["pos"][g, m] = 0
+                    ov["val"][g, m] = 1 if lens[j] == 1 else 0
+                    st[1] = 1
+                else:
+                    # re-feed the (possibly mid-ring) last prompt token
+                    ov["tok"][g, m] = prompts[j][idx - 1]
+                    ov["pos"][g, m] = idx - 1
+                    ov["val"][g, m] = 0
+            return {k: jnp.asarray(v) for k, v in ov.items()}, fed
+
+        def build_step_ov():
+            """Feed one prompt token per filling lane this rotation."""
+            fed = dict(active)
+            if not filling:
+                return empty_dev, fed
+            ov = self._empty_overrides()
+            for (g, m), st in filling.items():
+                j, idx = st
+                ov["flag"][g, m] = 1
+                ov["sid"][g] = g
+                ov["tok"][g, m] = prompts[j][idx]
+                ov["pos"][g, m] = idx
+                ov["val"][g, m] = 1 if idx == lens[j] - 1 else 0
+                fed[(g, m)] = j
+                st[1] = idx + 1
+            return ov, fed
+
+        need_reseed = True  # initial seeding uses the same re-seed path
+        # hard bound on rotations (scheduler-bug backstop: every sample costs
+        # at most lens + max_new_tokens rotations, plus seeding and drain)
+        max_rot = 2 + 2 * S + N + sum(l + max_new_tokens for l in lens)
         # Ctrl-C mid-ring returns partial results (single-process; in a
         # multi-process job an interrupt tears down the whole SPMD group)
         with catch_loop_errors() as guard:
-            while n_tok < max_new_tokens and not all(done):
-                if max(lens) + n_tok + 1 > self.max_seq_length:
-                    break
+            while active or filling or queue:
+                if stats.rotations >= max_rot:
+                    raise RuntimeError(
+                        f"pipeline scheduler exceeded {max_rot} rotations with "
+                        f"{len(active)} active / {len(filling)} filling / "
+                        f"{len(queue)} queued samples"
+                    )
+                if batch_refills():
+                    need_reseed = True
+                schedule_token_refills()
+                if not (active or filling):
+                    continue  # everything finished during prefill; the while
+                    # condition re-checks the queue (refills strictly drain it)
+                if need_reseed:
+                    fed_prev = {}
+                    payload = self._init_payload(1, dtype)
+                    ov_dev, fed_cur = build_reseed_ov()
+                    need_reseed = False
+                else:
+                    fed_prev = fed_cur
+                    ov, fed_cur = build_step_ov()
+                    ov_dev = (
+                        ov if ov is empty_dev
+                        else {k: jnp.asarray(v) for k, v in ov.items()}
+                    )
                 self.key, sub = jax.random.split(self.key)
                 kv, payload, emits = decode(
                     self.stage_blocks,
@@ -571,26 +727,38 @@ class PipelineEngine:
                     ov_dev,
                     sub,
                 )
-                if not seeded:
-                    # the seeding rotation emits only bubble payloads
-                    ov_dev = empty_dev
-                    seeded = True
-                    continue
+                stats.rotations += 1
+
+                # collect tokens fed one rotation ago
                 toks_e, sids_e, vals_e = self._stage0_emits(emits)
                 for t_row, s, v_row in zip(toks_e, sids_e, vals_e):
                     s = int(s)
-                    if s >= n_groups:
-                        continue
                     for m in range(M):
-                        j = s * M + m
-                        if v_row[m] and j < Wn and not done[j]:
-                            out[j].append(int(t_row[m]))
-                            if detect_stop_tokens(out[j][lens[j] :], stop_sequences):
-                                done[j] = True
-                n_tok += 1
-                stats.tok_time.append(
-                    (sum(len(o) - l for o, l in zip(out, lens)), time.perf_counter() - t_all)
-                )
+                        j = fed_prev.get((s, m))
+                        if j is None or not v_row[m] or done[j]:
+                            continue
+                        out[j].append(int(t_row[m]))
+                        if (
+                            detect_stop_tokens(out[j][lens[j] :], stop_sequences)
+                            or budget(j) <= 0
+                        ):
+                            done[j] = True
+                            active.pop((s, m), None)
+                if fed_prev:
+                    stats.tok_time.append(
+                        (
+                            sum(len(o) - l for o, l in zip(out, lens)),
+                            time.perf_counter() - t_all,
+                        )
+                    )
+
+                # a lane whose last prompt token was just fed switches to
+                # generating (auto-feed inside the jit)
+                for lane in list(filling):
+                    j, idx = filling[lane]
+                    if idx >= lens[j]:
+                        del filling[lane]
+                        active[lane] = j
 
         stats.interrupted = stats.interrupted or guard.interrupted
         trimmed = []
