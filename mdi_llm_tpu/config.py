@@ -936,11 +936,20 @@ for nm, (org, (L, D, H, I, bs)) in {
             n_head=H,
             n_embd=D,
             n_query_groups=groups,
-            norm_eps=1e-6 if "open_llama" in nm else 1e-5,
+            # longchat also uses 1e-6 (reference config.py:736,758)
+            norm_eps=1e-6 if ("open_llama" in nm or "longchat" in nm) else 1e-5,
             intermediate_size=I,
-            # LLaMA-2-7B-32K extends 4k->32k via positional interpolation
-            # (reference config.py:1445: rope_condense_ratio=8)
-            **(dict(rope_condense_ratio=8) if nm == "LLaMA-2-7B-32K" else {}),
+            # long-context variants extend their base context via positional
+            # interpolation: longchat 2k->16k and LLaMA-2-7B-32K 4k->32k
+            # condense by 8, vicuna-v1.5-16k 4k->16k by 4 (reference
+            # config.py:666,700,735,757,1445)
+            **(
+                dict(rope_condense_ratio=8)
+                if nm == "LLaMA-2-7B-32K" or "longchat" in nm
+                else dict(rope_condense_ratio=4)
+                if nm.endswith("-16k")
+                else {}
+            ),
             **_llama,
         )
     )

@@ -133,7 +133,9 @@ class Generator:
         if quantize == "int8":
             from mdi_llm_tpu.ops.quant import quantize_params
 
-            params = quantize_params(params)
+            # quantization happens host-side (numpy); pin the tree on device
+            # or every jit call re-uploads the whole model
+            params = jax.device_put(quantize_params(params))
         elif quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.params = params
@@ -363,13 +365,18 @@ class Generator:
                             top_p=top_p,
                         )
                         toks_np = np.asarray(toks_j)
+                        fed = 0
                         for i in range(c):
                             n += 1
+                            fed = i + 1
                             emit(toks_np[i], n)
                             if done[0]:
                                 break
-                        tok = toks_np[-1]
-                        positions = positions + c
+                        # advance by tokens actually emitted: a stop sequence
+                        # mid-chunk must not leave positions pointing past the
+                        # last real token (poisons continuation/cache reuse)
+                        tok = toks_np[fed - 1]
+                        positions = positions + fed
                         continue
                     draft = (list(draft) + [0] * K)[:K]
                     toks_in = np.asarray([[int(tok[0])] + draft], np.int32)
@@ -382,13 +389,15 @@ class Generator:
                         a += 1
                     emitted = [int(x) for x in g[: a + 1]]
                     allowed = min(len(emitted), max_new_tokens - n)
+                    fed = 0
                     for t in emitted[:allowed]:
                         n += 1
+                        fed += 1
                         emit(np.asarray([t]), n)
                         if done[0]:
                             break
-                    tok = np.asarray([emitted[allowed - 1]], np.int32)
-                    positions = positions + allowed
+                    tok = np.asarray([emitted[fed - 1]], np.int32)
+                    positions = positions + fed
             stats.interrupted = g_spec.interrupted
             # the plain loop below finishes any tail the cache window allows
 

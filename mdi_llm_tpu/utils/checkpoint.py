@@ -192,9 +192,18 @@ def convert_hf_checkpoint(
 
 
 def _model_type_for(cfg: Config) -> str:
+    """Classify a config into its HF naming family purely structurally —
+    name sniffing misroutes e.g. llama finetunes with "phi" in the repo
+    name.  Among the parallel-residual GptNeoxMLP families: phi has a
+    biased LM head, falcon has bias-free linears, neox has biased linears
+    (invariants of the reference config registry)."""
     if cfg.pos_embedding == "learned":
         return "gpt2"
     if cfg.mlp_class_name == "GptNeoxMLP" and cfg.parallel_residual:
+        if cfg.lm_head_bias:
+            return "phi"
+        if not cfg.bias:
+            return "falcon"
         return "gpt_neox"
     return "llama"
 
@@ -371,21 +380,34 @@ def _map_neox(cfg: Config, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
 def _map_falcon(cfg: Config, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
     """HF falcon naming → pytree (≡ `copy_weights_falcon`,
     convert_hf_checkpoint.py:61-107).  Falcon's fused query_key_value is
-    already the per-group [q…, k, v] interleave.  Covers the 7b layout
-    (parallel attention, shared input_layernorm); the 40b two-norm
-    `new_decoder_architecture` is not wired yet."""
-    if not cfg.shared_attention_norm:
-        raise NotImplementedError("falcon new_decoder_architecture layout")
+    already the per-group [q…, k, v] interleave.  Covers both layouts: the
+    7b one (parallel attention, shared input_layernorm) and the 40b/180B
+    `new_decoder_architecture` (two norms: ln_attn + ln_mlp)."""
     L = cfg.n_layer
     layers = []
     for i in range(L):
         pre = f"transformer.h.{i}."
-        layers.append(
-            {
+        if cfg.shared_attention_norm:  # 7b layout
+            norms = {
                 "norm_1": {
                     "weight": raw[pre + "input_layernorm.weight"],
                     "bias": raw[pre + "input_layernorm.bias"],
                 },
+            }
+        else:  # 40b/180B new_decoder_architecture
+            norms = {
+                "norm_1": {
+                    "weight": raw[pre + "ln_attn.weight"],
+                    "bias": raw[pre + "ln_attn.bias"],
+                },
+                "norm_2": {
+                    "weight": raw[pre + "ln_mlp.weight"],
+                    "bias": raw[pre + "ln_mlp.bias"],
+                },
+            }
+        layers.append(
+            {
+                **norms,
                 "attn": {
                     "qkv": {"weight": raw[pre + "self_attention.query_key_value.weight"]},
                     "proj": {"weight": raw[pre + "self_attention.dense.weight"]},
@@ -473,13 +495,40 @@ def _map_phi(cfg: Config, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
-# Reverse conversion (≡ convert_lit_checkpoint.py, llama family)
+# Reverse conversion (≡ convert_lit_checkpoint.py: llama/neox/falcon/phi,
+# plus gpt2 beyond parity)
 # ---------------------------------------------------------------------------
 
 
+def _split_qkv_bias(cfg: Config, qkv_b: np.ndarray):
+    q, k, v = split_qkv(cfg, qkv_b[:, None])
+    return q[:, 0], k[:, 0], v[:, 0]
+
+
 def convert_to_hf_state_dict(cfg: Config, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
-    if cfg.mlp_class_name not in ("LLaMAMLP",):
-        raise NotImplementedError("reverse conversion currently covers the llama family")
+    """Native pytree → HF state-dict naming, dispatched by model family
+    (≡ `convert_lit_checkpoint.py:15-220` copy_weights_falcon /
+    copy_weights_gpt_neox / copy_weights_llama / copy_weights_phi)."""
+    mt = _model_type_for(cfg)
+    if mt == "falcon":
+        return _rev_falcon(cfg, params)
+    if mt == "phi":
+        return _rev_phi(cfg, params)
+    if mt == "gpt_neox":
+        return _rev_neox(cfg, params)
+    if mt == "gpt2":
+        return _rev_gpt2(cfg, params)
+    return _rev_llama(cfg, params)
+
+
+def _rev_llama(cfg: Config, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    if cfg.mlp_class_name not in ("LLaMAMLP", "GemmaMLP", "LLaMAMoE"):
+        # e.g. RedPajama/StableLM: GptNeoxMLP without parallel residual has
+        # no HF llama naming to map onto
+        raise NotImplementedError(
+            f"reverse conversion not implemented for mlp_class_name="
+            f"{cfg.mlp_class_name!r} with parallel_residual={cfg.parallel_residual}"
+        )
     out: Dict[str, np.ndarray] = {}
     out["model.embed_tokens.weight"] = np.asarray(params["wte"]["weight"])[: cfg.vocab_size]
     out["model.norm.weight"] = np.asarray(params["ln_f"]["weight"])
@@ -488,17 +537,142 @@ def convert_to_hf_state_dict(cfg: Config, params: Dict[str, Any]) -> Dict[str, n
     b = params["blocks"]
     for i in range(cfg.n_layer):
         pre = f"model.layers.{i}."
-        qkv = np.asarray(b["attn"]["qkv"]["weight"][i])
-        q, k, v = split_qkv(cfg, qkv)
+        q, k, v = split_qkv(cfg, np.asarray(b["attn"]["qkv"]["weight"][i]))
         out[pre + "self_attn.q_proj.weight"] = q
         out[pre + "self_attn.k_proj.weight"] = k
         out[pre + "self_attn.v_proj.weight"] = v
         out[pre + "self_attn.o_proj.weight"] = np.asarray(b["attn"]["proj"]["weight"][i])
         out[pre + "input_layernorm.weight"] = np.asarray(b["norm_1"]["weight"][i])
         out[pre + "post_attention_layernorm.weight"] = np.asarray(b["norm_2"]["weight"][i])
-        out[pre + "mlp.gate_proj.weight"] = np.asarray(b["mlp"]["fc_1"]["weight"][i])
-        out[pre + "mlp.up_proj.weight"] = np.asarray(b["mlp"]["fc_2"]["weight"][i])
-        out[pre + "mlp.down_proj.weight"] = np.asarray(b["mlp"]["proj"]["weight"][i])
+        if cfg.mlp_class_name == "LLaMAMoE":
+            out[pre + "block_sparse_moe.gate.weight"] = np.asarray(
+                b["mlp"]["gate"]["weight"][i]
+            )
+            for e in range(cfg.n_expert):
+                ex = b["mlp"]["experts"]
+                out[f"{pre}block_sparse_moe.experts.{e}.w1.weight"] = np.asarray(
+                    ex["fc_1"]["weight"][i, e]
+                )
+                out[f"{pre}block_sparse_moe.experts.{e}.w3.weight"] = np.asarray(
+                    ex["fc_2"]["weight"][i, e]
+                )
+                out[f"{pre}block_sparse_moe.experts.{e}.w2.weight"] = np.asarray(
+                    ex["proj"]["weight"][i, e]
+                )
+        else:  # LLaMAMLP / GemmaMLP
+            out[pre + "mlp.gate_proj.weight"] = np.asarray(b["mlp"]["fc_1"]["weight"][i])
+            out[pre + "mlp.up_proj.weight"] = np.asarray(b["mlp"]["fc_2"]["weight"][i])
+            out[pre + "mlp.down_proj.weight"] = np.asarray(b["mlp"]["proj"]["weight"][i])
+    return out
+
+
+def _rev_neox(cfg: Config, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    # pythia-family HF checkpoints size their embeddings at the PADDED vocab
+    # (GPTNeoXConfig.vocab_size == 50304): emit all rows, no truncation
+    out["gpt_neox.embed_in.weight"] = np.asarray(params["wte"]["weight"])
+    out["gpt_neox.final_layer_norm.weight"] = np.asarray(params["ln_f"]["weight"])
+    out["gpt_neox.final_layer_norm.bias"] = np.asarray(params["ln_f"]["bias"])
+    out["embed_out.weight"] = np.asarray(params["lm_head"]["weight"])
+    b = params["blocks"]
+    for i in range(cfg.n_layer):
+        pre = f"gpt_neox.layers.{i}."
+        out[pre + "input_layernorm.weight"] = np.asarray(b["norm_1"]["weight"][i])
+        out[pre + "input_layernorm.bias"] = np.asarray(b["norm_1"]["bias"][i])
+        out[pre + "post_attention_layernorm.weight"] = np.asarray(b["norm_2"]["weight"][i])
+        out[pre + "post_attention_layernorm.bias"] = np.asarray(b["norm_2"]["bias"][i])
+        out[pre + "attention.query_key_value.weight"] = np.asarray(
+            b["attn"]["qkv"]["weight"][i]
+        )
+        out[pre + "attention.query_key_value.bias"] = np.asarray(b["attn"]["qkv"]["bias"][i])
+        out[pre + "attention.dense.weight"] = np.asarray(b["attn"]["proj"]["weight"][i])
+        out[pre + "attention.dense.bias"] = np.asarray(b["attn"]["proj"]["bias"][i])
+        out[pre + "mlp.dense_h_to_4h.weight"] = np.asarray(b["mlp"]["fc"]["weight"][i])
+        out[pre + "mlp.dense_h_to_4h.bias"] = np.asarray(b["mlp"]["fc"]["bias"][i])
+        out[pre + "mlp.dense_4h_to_h.weight"] = np.asarray(b["mlp"]["proj"]["weight"][i])
+        out[pre + "mlp.dense_4h_to_h.bias"] = np.asarray(b["mlp"]["proj"]["bias"][i])
+    return out
+
+
+def _rev_falcon(cfg: Config, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    out["transformer.word_embeddings.weight"] = np.asarray(params["wte"]["weight"])[
+        : cfg.vocab_size
+    ]
+    out["transformer.ln_f.weight"] = np.asarray(params["ln_f"]["weight"])
+    out["transformer.ln_f.bias"] = np.asarray(params["ln_f"]["bias"])
+    out["lm_head.weight"] = np.asarray(params["lm_head"]["weight"])[: cfg.vocab_size]
+    b = params["blocks"]
+    for i in range(cfg.n_layer):
+        pre = f"transformer.h.{i}."
+        if cfg.shared_attention_norm:  # 7b layout
+            out[pre + "input_layernorm.weight"] = np.asarray(b["norm_1"]["weight"][i])
+            out[pre + "input_layernorm.bias"] = np.asarray(b["norm_1"]["bias"][i])
+        else:  # 40b/180B new_decoder_architecture
+            out[pre + "ln_attn.weight"] = np.asarray(b["norm_1"]["weight"][i])
+            out[pre + "ln_attn.bias"] = np.asarray(b["norm_1"]["bias"][i])
+            out[pre + "ln_mlp.weight"] = np.asarray(b["norm_2"]["weight"][i])
+            out[pre + "ln_mlp.bias"] = np.asarray(b["norm_2"]["bias"][i])
+        out[pre + "self_attention.query_key_value.weight"] = np.asarray(
+            b["attn"]["qkv"]["weight"][i]
+        )
+        out[pre + "self_attention.dense.weight"] = np.asarray(b["attn"]["proj"]["weight"][i])
+        out[pre + "mlp.dense_h_to_4h.weight"] = np.asarray(b["mlp"]["fc"]["weight"][i])
+        out[pre + "mlp.dense_4h_to_h.weight"] = np.asarray(b["mlp"]["proj"]["weight"][i])
+    return out
+
+
+def _rev_phi(cfg: Config, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(params["wte"]["weight"])[: cfg.vocab_size]
+    out["model.final_layernorm.weight"] = np.asarray(params["ln_f"]["weight"])
+    out["model.final_layernorm.bias"] = np.asarray(params["ln_f"]["bias"])
+    out["lm_head.weight"] = np.asarray(params["lm_head"]["weight"])[: cfg.vocab_size]
+    out["lm_head.bias"] = np.asarray(params["lm_head"]["bias"])[: cfg.vocab_size]
+    b = params["blocks"]
+    for i in range(cfg.n_layer):
+        pre = f"model.layers.{i}."
+        out[pre + "input_layernorm.weight"] = np.asarray(b["norm_1"]["weight"][i])
+        out[pre + "input_layernorm.bias"] = np.asarray(b["norm_1"]["bias"][i])
+        q, k, v = split_qkv(cfg, np.asarray(b["attn"]["qkv"]["weight"][i]))
+        qb, kb, vb = _split_qkv_bias(cfg, np.asarray(b["attn"]["qkv"]["bias"][i]))
+        out[pre + "self_attn.q_proj.weight"], out[pre + "self_attn.q_proj.bias"] = q, qb
+        out[pre + "self_attn.k_proj.weight"], out[pre + "self_attn.k_proj.bias"] = k, kb
+        out[pre + "self_attn.v_proj.weight"], out[pre + "self_attn.v_proj.bias"] = v, vb
+        out[pre + "self_attn.dense.weight"] = np.asarray(b["attn"]["proj"]["weight"][i])
+        out[pre + "self_attn.dense.bias"] = np.asarray(b["attn"]["proj"]["bias"][i])
+        out[pre + "mlp.fc1.weight"] = np.asarray(b["mlp"]["fc"]["weight"][i])
+        out[pre + "mlp.fc1.bias"] = np.asarray(b["mlp"]["fc"]["bias"][i])
+        out[pre + "mlp.fc2.weight"] = np.asarray(b["mlp"]["proj"]["weight"][i])
+        out[pre + "mlp.fc2.bias"] = np.asarray(b["mlp"]["proj"]["bias"][i])
+    return out
+
+
+def _rev_gpt2(cfg: Config, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Inverse of `_map_gpt2`: de-interleave QKV back to HF's fused [q;k;v]
+    and restore the Conv1D (in, out) transposition.  lm_head is tied."""
+    out: Dict[str, np.ndarray] = {}
+    out["transformer.wte.weight"] = np.asarray(params["wte"]["weight"])[: cfg.vocab_size]
+    out["transformer.wpe.weight"] = np.asarray(params["wpe"]["weight"])
+    out["transformer.ln_f.weight"] = np.asarray(params["ln_f"]["weight"])
+    out["transformer.ln_f.bias"] = np.asarray(params["ln_f"]["bias"])
+    b = params["blocks"]
+    for i in range(cfg.n_layer):
+        pre = f"transformer.h.{i}."
+        q, k, v = split_qkv(cfg, np.asarray(b["attn"]["qkv"]["weight"][i]))
+        qb, kb, vb = _split_qkv_bias(cfg, np.asarray(b["attn"]["qkv"]["bias"][i]))
+        out[pre + "attn.c_attn.weight"] = np.concatenate([q, k, v], axis=0).T
+        out[pre + "attn.c_attn.bias"] = np.concatenate([qb, kb, vb], axis=0)
+        out[pre + "attn.c_proj.weight"] = np.asarray(b["attn"]["proj"]["weight"][i]).T
+        out[pre + "attn.c_proj.bias"] = np.asarray(b["attn"]["proj"]["bias"][i])
+        out[pre + "ln_1.weight"] = np.asarray(b["norm_1"]["weight"][i])
+        out[pre + "ln_1.bias"] = np.asarray(b["norm_1"]["bias"][i])
+        out[pre + "ln_2.weight"] = np.asarray(b["norm_2"]["weight"][i])
+        out[pre + "ln_2.bias"] = np.asarray(b["norm_2"]["bias"][i])
+        out[pre + "mlp.c_fc.weight"] = np.asarray(b["mlp"]["fc"]["weight"][i]).T
+        out[pre + "mlp.c_fc.bias"] = np.asarray(b["mlp"]["fc"]["bias"][i])
+        out[pre + "mlp.c_proj.weight"] = np.asarray(b["mlp"]["proj"]["weight"][i]).T
+        out[pre + "mlp.c_proj.bias"] = np.asarray(b["mlp"]["proj"]["bias"][i])
     return out
 
 

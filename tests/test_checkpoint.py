@@ -243,6 +243,118 @@ def test_hf_mixtral_moe_logit_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=3e-4, atol=3e-4)
 
 
+def test_hf_falcon_new_decoder_logit_parity(tmp_path):
+    """Falcon 40b/180B layout: new_decoder_architecture (GQA, two norms:
+    ln_attn + ln_mlp) — reference convert_hf_checkpoint.py:88-94."""
+    torch = pytest.importorskip("torch")
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(
+        vocab_size=96,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_kv_heads=2,
+        new_decoder_architecture=True,
+        bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(8)
+    model = FalconForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path / "hf")
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    assert cfg.n_query_groups == 2 and not cfg.shared_attention_norm
+
+    toks = np.array([[4, 7, 2, 90, 31, 8]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks)).logits.numpy()
+    got, _ = forward(cfg, params, jnp.asarray(toks, jnp.int32), jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got)[..., : hf_cfg.vocab_size], ref, rtol=3e-4, atol=3e-4
+    )
+
+
+def _assert_reverse_roundtrip(model, tmp_path, allow_missing=()):
+    """HF model → native → HF state dict must reproduce the original tensors
+    bit-exactly for every key the reverse map emits."""
+    model.save_pretrained(tmp_path / "hf")
+    out_dir = convert_hf_checkpoint(tmp_path / "hf", dtype=jnp.float32)
+    cfg, params = load_checkpoint(out_dir)
+    sd = convert_to_hf_state_dict(cfg, params)
+    assert sd, "reverse conversion produced nothing"
+    ref_sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    for k, v in sd.items():
+        np.testing.assert_array_equal(v, ref_sd[k], err_msg=k)
+    # nothing real was dropped: every original tensor is covered except
+    # non-weight buffers and the explicitly allowed (tied) entries
+    missing = set(ref_sd) - set(sd)
+    for k in missing:
+        assert (
+            "rotary" in k or "masked_bias" in k or ".attn.bias" in k
+            or k in allow_missing
+        ), f"reverse map silently dropped {k}"
+
+
+def test_reverse_roundtrip_neox(tmp_path):
+    """≡ reference copy_weights_gpt_neox (convert_lit_checkpoint.py:77-110)."""
+    torch = pytest.importorskip("torch")
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128, rotary_pct=0.25,
+        max_position_embeddings=64, use_parallel_residual=True,
+    )
+    torch.manual_seed(12)
+    _assert_reverse_roundtrip(GPTNeoXForCausalLM(hf_cfg).eval(), tmp_path)
+
+
+@pytest.mark.parametrize("new_arch", [False, True])
+def test_reverse_roundtrip_falcon(tmp_path, new_arch):
+    """≡ reference copy_weights_falcon (convert_lit_checkpoint.py:15-74),
+    both the 7b and the 40b/180B (new_decoder_architecture) layouts."""
+    torch = pytest.importorskip("torch")
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, bias=False, tie_word_embeddings=False,
+        new_decoder_architecture=new_arch,
+        **({"num_kv_heads": 2} if new_arch else {"multi_query": True, "parallel_attn": True}),
+    )
+    torch.manual_seed(13)
+    _assert_reverse_roundtrip(FalconForCausalLM(hf_cfg).eval(), tmp_path)
+
+
+def test_reverse_roundtrip_phi(tmp_path):
+    """≡ reference copy_weights_phi (convert_lit_checkpoint.py:168-220)."""
+    torch = pytest.importorskip("torch")
+    from transformers import PhiConfig, PhiForCausalLM
+
+    hf_cfg = PhiConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, partial_rotary_factor=0.5,
+        max_position_embeddings=64, tie_word_embeddings=False,
+    )
+    torch.manual_seed(14)
+    _assert_reverse_roundtrip(PhiForCausalLM(hf_cfg).eval(), tmp_path)
+
+
+def test_reverse_roundtrip_gpt2(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4
+    )
+    torch.manual_seed(15)
+    # gpt2 ties lm_head to wte: the reverse map emits only the embedding
+    _assert_reverse_roundtrip(
+        GPT2LMHeadModel(hf_cfg).eval(), tmp_path, allow_missing={"lm_head.weight"}
+    )
+
+
 def test_reverse_conversion_roundtrip(tmp_path):
     """convert_to_hf_state_dict must invert the fused layout exactly."""
     torch = pytest.importorskip("torch")

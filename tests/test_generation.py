@@ -105,6 +105,26 @@ def test_speculative_matches_plain_greedy():
         spec.generate([prompt, prompt], 5, temperature=0.0, speculative=4)
 
 
+def test_speculative_stop_sequence_parity():
+    """A stop sequence that fires mid-burst / mid-accept must leave the same
+    trimmed output as plain decode, and `positions` accounting must not run
+    past the last emitted token (drift poisons continuation)."""
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(11), dtype=jnp.float32)
+    prompt = [5, 9, 2, 7, 5, 9, 2, 7, 5, 9, 2, 7, 3]
+    plain = Generator(cfg, params, rng_seed=3)
+    spec = Generator(cfg, params, rng_seed=3)
+    free, _ = plain.generate([prompt], 24, temperature=0.0)
+    # stop on a token emitted deep enough that a draft/burst spans it
+    for cut in (3, 7, 12):
+        stop = [[free[0][len(prompt) + cut]]]
+        o1, _ = plain.generate([prompt], 24, temperature=0.0, stop_sequences=stop)
+        o2, _ = spec.generate(
+            [prompt], 24, temperature=0.0, speculative=4, stop_sequences=stop
+        )
+        assert o1 == o2, f"cut={cut}: speculative+stop diverged"
+
+
 def test_ngram_draft_lookup():
     from mdi_llm_tpu.generation import ngram_draft
 

@@ -279,6 +279,15 @@ def test_registry_spot_facts():
     assert Config.from_name("pythia-31m").block_size == 1024
     k32 = Config.from_name("LLaMA-2-7B-32K")
     assert k32.rope_condense_ratio == 8 and k32.block_size == 32768
+    # positional-interpolation long-context variants (reference
+    # config.py:666,700,735,757)
+    for nm in ("longchat-7b-16k", "longchat-13b-16k"):
+        lc = Config.from_name(nm)
+        assert lc.rope_condense_ratio == 8 and lc.norm_eps == 1e-6
+    for nm in ("vicuna-7b-v1.5-16k", "vicuna-13b-v1.5-16k"):
+        vc = Config.from_name(nm)
+        assert vc.rope_condense_ratio == 4 and vc.norm_eps == 1e-5
+    assert Config.from_name("vicuna-7b-v1.5").rope_condense_ratio == 1
     sc = Config.from_name("stable-code-3b")
     assert sc.mlp_class_name == "LLaMAMLP" and sc.padded_vocab_size == 50304
     mx = Config.from_name("Mixtral-8x7B-v0.1")
