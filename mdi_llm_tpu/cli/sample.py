@@ -51,6 +51,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=1,
         help="pipeline mode: samples batched per ring slot (M)",
     )
+    ap.add_argument(
+        "--sp-devices",
+        type=int,
+        default=0,
+        help="sequence-parallel inference over N devices: ring-attention "
+        "prefill + sequence-sharded KV cache (context scales with N)",
+    )
     # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
     ap.add_argument("--process-id", type=int, default=None)
@@ -107,7 +114,25 @@ def main(argv=None):
     )  # ≡ reference sample.py:34-37
     t_load = time.perf_counter()
     with profile(logdir=args.profile_dir, host_profile_path=host_prof):
-        if args.pipeline_stages:
+        if args.sp_devices:
+            if args.pipeline_stages:
+                raise SystemExit("--sp-devices and --pipeline-stages are exclusive")
+            if args.speculative:
+                raise SystemExit("--speculative applies to single-device decode only")
+            if args.quantize not in (None, "none"):
+                raise SystemExit("--quantize is not supported with --sp-devices yet")
+            from mdi_llm_tpu.parallel.sp_inference import SPGenerator
+
+            engine = SPGenerator(
+                cfg, params, n_devices=args.sp_devices, max_seq_length=seq_len,
+                rng_seed=args.seed, cache_dtype=resolve_kv_dtype(args.kv_dtype),
+            )
+            n_nodes = args.sp_devices
+            outs, stats = engine.generate(
+                prompt_ids, args.n_tokens, temperature=temperature,
+                top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,
+            )
+        elif args.pipeline_stages:
             from mdi_llm_tpu.parallel.pipeline import PipelineEngine
 
             engine = PipelineEngine(

@@ -147,6 +147,9 @@ def attention_forward(
     fresh_prefill: bool = False,  # input_pos==0 and cache empty: attend the
     # chunk itself (T×T) instead of the full cache buffer (T×S)
     use_flash: bool = False,  # pallas flash kernel on the chunk path
+    sp_meta: Optional[Tuple] = None,  # sp inference: (k_pos (B, C) absolute
+    # slot positions of the LOCAL cache shard, cache_off scalar local write
+    # offset, write_on scalar — this device owns the decode token)
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     B, T, D = x.shape
     qkv = linear(x, p["qkv"])
@@ -166,6 +169,43 @@ def attention_forward(
         k = jnp.concatenate(
             [apply_rope(k[..., :n_elem], cos_b, sin_b), k[..., n_elem:]], axis=-1
         )
+
+    if sp_axis is not None and k_cache is not None:
+        # sequence-sharded KV cache (sp inference): the cache shard holds
+        # LOCAL slots whose absolute positions live in sp_meta's k_pos
+        from mdi_llm_tpu.ops.ring_attention import ring_attention, ring_decode
+
+        if sp_meta is None:
+            raise ValueError("sp inference with a KV cache requires sp_meta")
+        kp, cache_off, write_on = sp_meta
+        if T > 1:
+            # sp prefill: every device writes its own chunk at local offset
+            # 0 and attends the distributed sequence over the ring
+            def upd0(cache, new):
+                return jax.lax.dynamic_update_slice(
+                    cache, new.astype(cache.dtype), (0, 0, 0)
+                )
+
+            k_cache = jax.vmap(upd0)(k_cache, k)
+            v_cache = jax.vmap(upd0)(v_cache, v)
+            y = ring_attention(q, k, v, pos, pos, sp_axis)
+        else:
+            # sp decode: only the owning device appends the token's K/V at
+            # cache_off.  The update itself is unconditional (in-place on the
+            # donated buffer); non-owners write back the slot's current value
+            # — a full-cache jnp.where select would double HBM traffic.
+            def updo(cache, new):
+                cur = jax.lax.dynamic_slice(
+                    cache, (0, cache_off, 0), (cache.shape[0], 1, cache.shape[2])
+                )
+                sel = jnp.where(write_on, new.astype(cache.dtype), cur)
+                return jax.lax.dynamic_update_slice(cache, sel, (0, cache_off, 0))
+
+            k_cache = jax.vmap(updo)(k_cache, k)
+            v_cache = jax.vmap(updo)(v_cache, v)
+            y = ring_decode(q, k_cache, v_cache, kp, pos, sp_axis)
+        y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size).astype(x.dtype)
+        return linear(y, p["proj"]), k_cache, v_cache
 
     if k_cache is not None:
         # scatter this chunk into the cache at each sample's offset (cache
@@ -188,8 +228,6 @@ def attention_forward(
         k_pos = pos  # uncached chunk: keys sit at the query positions
 
     if sp_axis is not None:
-        if k_cache is not None:
-            raise NotImplementedError("ring attention with KV cache: use dense per-chunk")
         from mdi_llm_tpu.ops.ring_attention import ring_attention
 
         y = ring_attention(q, k_att, v_att, pos, k_pos, sp_axis)
@@ -223,13 +261,14 @@ def block_forward(
     sp_axis: Optional[str] = None,
     fresh_prefill: bool = False,
     use_flash: bool = False,
+    sp_meta: Optional[Tuple] = None,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
     parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms."""
     n1 = _norm(cfg, x, p["norm_1"])
     att, k_cache, v_cache = attention_forward(
         cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis,
-        fresh_prefill, use_flash,
+        fresh_prefill, use_flash, sp_meta,
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
@@ -253,6 +292,7 @@ def run_blocks(
     sp_axis: Optional[str] = None,
     fresh_prefill: bool = False,
     use_flash: bool = False,
+    sp_meta: Optional[Tuple] = None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
     rematerializes each block under autodiff (training memory ∝ 1 layer's
@@ -276,8 +316,8 @@ def run_blocks(
     def body(carry, xs):
         layer_p, k_c, v_c = xs
         y, k_c, v_c = block_forward(
-            cfg, layer_p, carry, pos, cos, sin, k_c, v_c, input_pos,
-            fresh_prefill=fresh_prefill, use_flash=use_flash,
+            cfg, layer_p, carry, pos, cos, sin, k_c, v_c, input_pos, sp_axis,
+            fresh_prefill=fresh_prefill, use_flash=use_flash, sp_meta=sp_meta,
         )
         return y, (k_c, v_c)
 
@@ -322,6 +362,7 @@ def forward(
     sp_axis: Optional[str] = None,
     fresh_prefill: bool = False,
     use_flash: bool = False,
+    sp_meta: Optional[Tuple] = None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
 
@@ -345,6 +386,7 @@ def forward(
     x, kv = run_blocks(
         cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat,
         sp_axis=sp_axis, fresh_prefill=fresh_prefill, use_flash=use_flash,
+        sp_meta=sp_meta,
     )
     return head(cfg, params, x), kv
 

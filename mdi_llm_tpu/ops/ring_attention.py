@@ -87,3 +87,49 @@ def ring_attention(
     )
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.reshape(B, n_head, Tq, hs).astype(q.dtype)
+
+
+def ring_decode(
+    q: jnp.ndarray,  # (B, n_head, 1, hs) — the decode token, replicated
+    k_cache: jnp.ndarray,  # (B, n_groups, C, hs) LOCAL cache shard
+    v_cache: jnp.ndarray,  # (B, n_groups, C, hs)
+    k_pos: jnp.ndarray,  # (B, C) absolute position of each local slot
+    # (sentinel >= 2^30 marks an empty slot)
+    q_pos: jnp.ndarray,  # (B, 1) absolute query position
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Decode-step attention over a sequence-sharded KV cache: every device
+    computes online-softmax partials (m, l, o) over its local shard, then
+    the partials merge across the `axis_name` ring with one psum/pmax —
+    the distributed analog of flash-decoding.  No device ever holds the
+    full cache; per-step traffic is O(B · heads · hs).
+
+    Returns (B, n_head, 1, hs), replicated across the axis."""
+    B, n_head, Tq, hs = q.shape
+    _, n_groups, C, _ = k_cache.shape
+    if scale is None:
+        scale = 1.0 / (hs**0.5)
+    q_per_kv = n_head // n_groups
+    qg = q.reshape(B, n_groups, q_per_kv, Tq, hs)
+
+    s = jnp.einsum(
+        "bgqth,bgsh->bgqts", qg, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    valid = k_pos[:, None, :] <= q_pos[:, :, None]  # (B, 1, C); empty slots
+    # carry the sentinel position and are never <= a real q_pos
+    s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
+
+    m = jnp.max(s, axis=-1)  # (B, g, q, 1) local max
+    p = jnp.exp(jnp.maximum(s - m[..., None], -80.0))
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bgqts,bgsh->bgqth", p, v_cache.astype(jnp.float32))
+
+    # cross-device softmax merge
+    m_g = jax.lax.pmax(m, axis_name)
+    corr = jnp.exp(jnp.maximum(m - m_g, -80.0))
+    l_g = jax.lax.psum(l * corr, axis_name)
+    o_g = jax.lax.psum(o * corr[..., None], axis_name)
+    out = o_g / jnp.maximum(l_g[..., None], 1e-30)
+    return out.reshape(B, n_head, Tq, hs).astype(q.dtype)

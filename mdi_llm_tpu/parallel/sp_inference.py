@@ -1,0 +1,299 @@
+"""Sequence-parallel (ring-attention) inference: context scales with the
+number of devices.
+
+New design territory relative to the reference (SURVEY.md §5.7 — its context
+is bounded by one device's memory):
+
+- **Prefill**: the prompt is split into P contiguous chunks over the `sp`
+  mesh axis.  Each device embeds its chunk, runs the block stack with ring
+  attention (`ops/ring_attention.ring_attention`), and writes its chunk's
+  K/V into its LOCAL cache shard — no device ever materializes the full
+  sequence.
+- **Decode**: the new token is replicated; each device computes
+  online-softmax partials over its local cache shard and the partials merge
+  with one `pmax`/`psum` pair (`ops/ring_attention.ring_decode`) — the
+  distributed analog of flash-decoding.  The token's K/V is appended
+  round-robin to the devices' shards, so cache growth is balanced: per-chip
+  memory is O((prompt + generated) / P).
+- Slot→position indirection (`kp`): each local cache slot carries its
+  absolute sequence position (sentinel = empty), making the round-robin
+  placement transparent to attention masking.
+
+Golden parity with single-device generation is pinned by
+tests/test_sp_inference.py.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
+from mdi_llm_tpu.generation import (
+    GenerationStats,
+    _bucket,
+    detect_stop_tokens,
+    find_eot,
+)
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.ops.sampling import sample
+from mdi_llm_tpu.parallel.mesh import make_mesh
+from mdi_llm_tpu.utils.context_managers import catch_loop_errors
+
+POS_SENTINEL = np.int32(1 << 30)  # empty cache slot: never <= a real q_pos
+
+
+class SPGenerator:
+    """Compile-once sequence-parallel generation driver.
+
+    Weights are replicated over the `sp` axis; the KV cache (and so the
+    context) is sharded over it.  The per-device cache budget is
+    `ceil(prompt/P) + ceil(max_new/P)` slots versus `prompt + max_new` for a
+    single device."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        n_devices: Optional[int] = None,
+        devices: Optional[Sequence] = None,
+        mesh=None,
+        max_seq_length: Optional[int] = None,
+        cache_dtype=None,
+        rng_seed: int = 1337,
+        decode_chunk: int = 32,
+    ):
+        if mesh is None:
+            mesh = make_mesh(
+                {"sp": n_devices or len(devices or jax.devices())}, devices
+            )
+        self.mesh = mesh
+        self.P = int(mesh.devices.size)
+        self.cfg = cfg
+        self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
+        if cache_dtype is None:
+            cache_dtype = transformer.param_dtype(params)
+        self.cache_dtype = cache_dtype
+        self.decode_chunk = int(decode_chunk)
+        self.key = jax.random.PRNGKey(rng_seed)
+        repl = NamedSharding(mesh, P())
+        self.params = jax.device_put(params, repl)
+        self.rope = tuple(
+            jax.device_put(np.asarray(r), repl) for r in transformer.get_rope_cache(cfg)
+        )
+        self._prefill_jit: Dict[Tuple, Any] = {}
+        self._decode_jit: Dict[Tuple, Any] = {}
+
+    # -- sharding specs ------------------------------------------------------
+
+    @property
+    def _kv_spec(self):
+        return {"k": P(None, None, None, "sp", None), "v": P(None, None, None, "sp", None)}
+
+    def _init_kv(self, B: int, C: int):
+        cfg = self.cfg
+        shape = (cfg.n_layer, B, cfg.n_query_groups, self.P * C, cfg.head_size)
+        sh = NamedSharding(self.mesh, P(None, None, None, "sp", None))
+        return {
+            "k": jax.device_put(jnp.zeros(shape, self.cache_dtype), sh),
+            "v": jax.device_put(jnp.zeros(shape, self.cache_dtype), sh),
+        }
+
+    # -- compiled phases -----------------------------------------------------
+
+    def _get_prefill(self, B, Tl, C, temperature, top_k, top_p):
+        key = (B, Tl, C, temperature, top_k, top_p)
+        if key not in self._prefill_jit:
+            cfg = self.cfg
+
+            def body(params, rope, toks, lens, kv, rkey):
+                d = jax.lax.axis_index("sp")
+                start = (d * Tl).astype(jnp.int32)
+                input_pos = jnp.full((B,), start, jnp.int32)
+                gpos = start + jnp.arange(Tl, dtype=jnp.int32)
+                kp = jnp.concatenate(
+                    [
+                        jnp.where(gpos[None, :] < lens[:, None], gpos[None, :], POS_SENTINEL),
+                        jnp.full((B, C - Tl), POS_SENTINEL, jnp.int32),
+                    ],
+                    axis=1,
+                )
+                logits, kv = transformer.forward(
+                    cfg, params, toks, input_pos, kv=kv, rope=rope,
+                    sp_axis="sp", sp_meta=(kp, jnp.int32(0), jnp.bool_(False)),
+                )
+                # gather each sample's last-prompt-token logits to all devices
+                own = (lens - 1) // Tl == d  # (B,)
+                idx = jnp.clip(lens - 1 - start, 0, Tl - 1)
+                last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+                last = jax.lax.psum(
+                    jnp.where(own[:, None], last.astype(jnp.float32), 0.0), "sp"
+                )
+                tok = sample(
+                    last, rkey, temperature=temperature, top_k=top_k, top_p=top_p
+                ).astype(jnp.int32)
+                return kv, kp, tok
+
+            repl = P()
+            sm = jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    jax.tree_util.tree_map(lambda _: repl, self.params),
+                    (repl, repl),
+                    P(None, "sp"),
+                    repl,
+                    self._kv_spec,
+                    repl,
+                ),
+                out_specs=(self._kv_spec, P(None, "sp"), repl),
+            )
+            self._prefill_jit[key] = jax.jit(sm, donate_argnums=(4,))
+        return self._prefill_jit[key]
+
+    def _get_decode(self, B, Tl, C, n_steps, temperature, top_k, top_p):
+        key = (B, Tl, C, n_steps, temperature, top_k, top_p)
+        if key not in self._decode_jit:
+            cfg, Pn = self.cfg, self.P
+
+            def body(params, rope, kv, kp, tok, pos, step0, rkey):
+                d = jax.lax.axis_index("sp")
+
+                def step(carry, i):
+                    kv, kp, tok, pos, rkey = carry
+                    owner = (step0 + i) % Pn
+                    loc = Tl + (step0 + i) // Pn
+                    write_on = owner == d
+                    kp = jnp.where(
+                        write_on,
+                        jax.lax.dynamic_update_slice(kp, pos[:, None], (0, loc)),
+                        kp,
+                    )
+                    logits, kv = transformer.forward(
+                        cfg, params, tok[:, None], pos, kv=kv, rope=rope,
+                        sp_axis="sp", sp_meta=(kp, loc, write_on),
+                    )
+                    rkey, sub = jax.random.split(rkey)
+                    tok = sample(
+                        logits[:, -1], sub,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                    ).astype(jnp.int32)
+                    pos = pos + 1
+                    return (kv, kp, tok, pos, rkey), tok
+
+                carry, toks = jax.lax.scan(
+                    step, (kv, kp, tok, pos, rkey), jnp.arange(n_steps, dtype=jnp.int32)
+                )
+                kv, kp, tok, pos, _ = carry
+                return kv, kp, tok, pos, toks  # toks (n_steps, B)
+
+            repl = P()
+            sm = jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    jax.tree_util.tree_map(lambda _: repl, self.params),
+                    (repl, repl),
+                    self._kv_spec,
+                    P(None, "sp"),
+                    repl,
+                    repl,
+                    repl,
+                    repl,
+                ),
+                out_specs=(self._kv_spec, P(None, "sp"), repl, repl, repl),
+            )
+            self._decode_jit[key] = jax.jit(sm, donate_argnums=(2, 3))
+        return self._decode_jit[key]
+
+    # -- public API ----------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> Tuple[List[List[int]], GenerationStats]:
+        Pn = self.P
+        stats = GenerationStats()
+        B = len(prompts)
+        lens = [len(p) for p in prompts]
+        if min(lens) < 1:
+            raise ValueError("empty prompt")
+        if max(lens) + max_new_tokens > self.max_seq_length:
+            raise ValueError(
+                f"prompt+generation length {max(lens) + max_new_tokens} exceeds "
+                f"max_seq_length {self.max_seq_length}"
+            )
+        t0 = time.perf_counter()
+        # bucket the prompt length so repeated calls with nearby lengths
+        # reuse the compiled prefill/decode programs (≡ Generator._bucket)
+        Tl = -(-_bucket(max(lens)) // Pn)  # local prompt chunk
+        C = Tl + -(-max_new_tokens // Pn)  # local cache budget
+        toks_np = np.zeros((B, Tl * Pn), np.int32)
+        for b, p in enumerate(prompts):
+            toks_np[b, : lens[b]] = np.asarray(p, np.int32)
+
+        kv = self._init_kv(B, C)
+        prefill = self._get_prefill(B, Tl, C, temperature, top_k, top_p)
+        self.key, sub = jax.random.split(self.key)
+        kv, kp, tok = prefill(
+            self.params, self.rope, jnp.asarray(toks_np),
+            jnp.asarray(lens, jnp.int32), kv, sub,
+        )
+        stats.prefill_s = time.perf_counter() - t0
+
+        out = [list(p) for p in prompts]
+        done = [False] * B
+        tok_np = np.asarray(tok)
+        for b in range(B):
+            out[b].append(int(tok_np[b]))
+            if detect_stop_tokens(out[b][lens[b] :], stop_sequences):
+                done[b] = True
+        n = 1
+
+        # the decode step processes `tok` (just sampled) at its own position,
+        # which for the first generated token is the prompt length
+        pos = jnp.asarray(lens, jnp.int32)
+        step0 = 0
+        with catch_loop_errors() as guard:
+            while n < max_new_tokens and not all(done):
+                c = min(self.decode_chunk, max_new_tokens - n)
+                decode = self._get_decode(B, Tl, C, c, temperature, top_k, top_p)
+                self.key, sub = jax.random.split(self.key)
+                kv, kp, tok, pos, toks = decode(
+                    self.params, self.rope, kv, kp, tok, pos,
+                    jnp.int32(step0), sub,
+                )
+                step0 += c
+                toks_np = np.asarray(toks)
+                for i in range(c):
+                    n += 1
+                    for b in range(B):
+                        if not done[b]:
+                            out[b].append(int(toks_np[i, b]))
+                            if detect_stop_tokens(out[b][lens[b] :], stop_sequences):
+                                done[b] = True
+                    stats.tok_time.append(
+                        (
+                            sum(len(o) - l for o, l in zip(out, lens)),
+                            time.perf_counter() - t0,
+                        )
+                    )
+        stats.interrupted = guard.interrupted
+        stats.decode_s = time.perf_counter() - t0 - stats.prefill_s
+        trimmed = []
+        for o, l in zip(out, lens):
+            cut = find_eot(o[l:], stop_sequences)
+            trimmed.append(o[: l + cut])
+        stats.tokens_generated = sum(len(o) - l for o, l in zip(out, lens))
+        return trimmed, stats
