@@ -39,7 +39,7 @@ def main():
         help="pipeline mode: samples batched per ring slot (M)",
     )
     ap.add_argument("--dtype", choices=("bfloat16", "float16", "float32"), default="bfloat16")
-    ap.add_argument("--quantize", choices=("none", "int8"), default="none")
+    ap.add_argument("--quantize", choices=("none", "int8", "w8a8"), default="none")
     ap.add_argument("--kv-dtype", choices=("auto", "bfloat16", "float16", "float32", "float8"), default="auto")
     ap.add_argument("--chunk", type=int, default=128, help="decode steps per jit call")
     ap.add_argument(
@@ -70,7 +70,19 @@ def main():
     if args.mode == "prefill":
         from mdi_llm_tpu.generation import Generator
 
+        if args.pipeline:
+            raise SystemExit("--mode prefill benches the single-chip engine; drop --pipeline")
+        if args.prompt_len < 256:
+            raise SystemExit(
+                "--mode prefill needs --prompt-len >= 256 (the flash kernel "
+                "only engages above the small-tile threshold)"
+            )
+        if jax.default_backend() != "tpu":
+            print("warning: flash kernel needs TPU; both runs use the XLA path",
+                  flush=True)
+
         def best_prefill(use_flash):
+            use_flash = use_flash and jax.default_backend() == "tpu"
             eng = Generator(
                 cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
                 use_flash=use_flash, quantize=args.quantize,
@@ -118,7 +130,7 @@ def main():
         )
         label = f"pipeline{args.pipeline}" + (
             f"xM{args.samples_per_slot}" if args.samples_per_slot > 1 else ""
-        ) + ("+int8" if args.quantize == "int8" else "")
+        ) + (f"+{args.quantize}" if args.quantize != "none" else "")
     else:
         from mdi_llm_tpu.generation import Generator
 
@@ -126,7 +138,9 @@ def main():
             cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
             quantize=args.quantize,
         )
-        label = "batched-decode" + ("+int8" if args.quantize == "int8" else "")
+        label = "batched-decode" + (
+            f"+{args.quantize}" if args.quantize != "none" else ""
+        )
 
     kwargs = {} if args.pipeline else {"chunk_size": args.chunk}
     # warmup (compile)

@@ -49,9 +49,11 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--device", default=None, help="jax platform override (tpu/cpu)")
     ap.add_argument(
         "--quantize",
-        choices=("none", "int8"),
+        choices=("none", "int8", "w8a8"),
         default="none",
-        help="weight-only quantization (int8 halves HBM traffic per decode step)",
+        help="int8: weight-only (halves weight HBM traffic, near-exact); "
+        "w8a8: also dynamically quantizes activations for full int8 MXU "
+        "matmuls (faster, coarser numerics)",
     )
     ap.add_argument(
         "--kv-dtype",

@@ -127,15 +127,17 @@ class Generator:
         cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
         use_flash: Optional[bool] = None,  # None → auto (TPU backend)
-        quantize: Optional[str] = None,  # None | "int8" (weight-only)
+        quantize: Optional[str] = None,  # None | "int8" (weight-only) |
+        # "w8a8" (dynamic activation quant, full int8 MXU matmuls)
     ):
         self.cfg = cfg
-        if quantize == "int8":
+        if quantize in ("int8", "w8a8"):
             from mdi_llm_tpu.ops.quant import quantize_params
 
             # quantization happens host-side (numpy); pin the tree on device
             # or every jit call re-uploads the whole model
-            params = jax.device_put(quantize_params(params))
+            mode = "w8" if quantize == "int8" else "w8a8"
+            params = jax.device_put(quantize_params(params, mode=mode))
         elif quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.params = params

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for decode-time memory bandwidth.
+"""Int8 quantization (weight-only or W8A8) for decode-time memory bandwidth.
 
 Beyond-reference capability (the reference runs fp16/bf16 only;
 `gptserver.py:199-209` dtype selection): batched autoregressive decode on
@@ -16,6 +16,17 @@ Quantized layout: a linear's param dict {"weight": (..., out, in)} becomes
 {"weight_q": int8 (..., out, in), "scale": f32 (..., out)}.  1-D weights
 (norms), biases, and the embedding table (gather path, also the tied head)
 are left in the original dtype.
+
+Two execution modes, chosen at quantization time:
+
+- `mode="w8"` (default): weight-only — the int8 weight is upcast to the
+  activation dtype inside the matmul.  Exact numerics up to the weight
+  rounding.
+- `mode="w8a8"`: activations are ALSO quantized per token (dynamic
+  symmetric int8), and the contraction runs int8×int8→int32 — on TPU v5e
+  this hits the MXU's double-rate int8 path and reads no bf16 weight copy
+  at all.  Stored under key "weight_q8" so the einsum can dispatch without
+  any plumbing; slightly coarser numerics (pinned by tests).
 """
 
 from __future__ import annotations
@@ -49,13 +60,19 @@ def dequantize_tensor(q: np.ndarray, scale: np.ndarray, dtype=np.float32):
 
 
 def is_quantized(p: Params) -> bool:
-    return isinstance(p, dict) and "weight_q" in p
+    return isinstance(p, dict) and ("weight_q" in p or "weight_q8" in p)
 
 
-def quantize_params(params: Params, skip: Sequence[str] = SKIP_KEYS) -> Params:
+def quantize_params(
+    params: Params, skip: Sequence[str] = SKIP_KEYS, mode: str = "w8"
+) -> Params:
     """Walk a param tree, replacing every >=2-D "weight" (outside `skip`
-    subtrees) with int8 weight_q + f32 scale.  Biases/norm weights pass
-    through unchanged."""
+    subtrees) with int8 weight_q (+ f32 scale).  Biases/norm weights pass
+    through unchanged.  `mode` selects the execution path ("w8" weight-only
+    upcast vs "w8a8" full int8 matmul) via the storage key."""
+    if mode not in ("w8", "w8a8"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    wkey = "weight_q" if mode == "w8" else "weight_q8"
 
     def walk(node, name):
         if not isinstance(node, dict):
@@ -66,7 +83,7 @@ def quantize_params(params: Params, skip: Sequence[str] = SKIP_KEYS) -> Params:
         for k, v in node.items():
             if k == "weight" and np.asarray(v).ndim >= 2:
                 q, s = quantize_tensor(np.asarray(v))
-                out["weight_q"], out["scale"] = q, s
+                out[wkey], out["scale"] = q, s
             else:
                 out[k] = walk(v, k)
         return out
@@ -78,7 +95,21 @@ def quantized_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     """einsum against a (possibly) quantized weight dict.  `spec` contracts
     x with the stored (out, in)-layout weight; the per-out-channel scale is
     applied to the result (exact: it factors out of the contraction)."""
-    if is_quantized(p):
+    if "weight_q8" in p:
+        # dynamic per-token symmetric activation quant + int8×int8 MXU dot
+        amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        xs = jnp.maximum(amax / 127.0, 1e-10)
+        xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127, 127).astype(
+            jnp.int8
+        )
+        y = jnp.einsum(spec, xq, p["weight_q8"], preferred_element_type=jnp.int32)
+        # xs covers x's leading (token/batch) dims; pad trailing singleton
+        # axes so it broadcasts over whatever output dims the spec appended
+        # (1 for plain linears, 2 for the expert einsums)
+        extra = y.ndim - (x.ndim - 1)
+        xs = xs.reshape(xs.shape[:-1] + (1,) * max(extra, 1))
+        return (y.astype(jnp.float32) * xs * p["scale"]).astype(x.dtype)
+    if "weight_q" in p:
         y = jnp.einsum(spec, x, p["weight_q"].astype(x.dtype))
         return y * p["scale"].astype(x.dtype)
     return jnp.einsum(spec, x, p["weight"])

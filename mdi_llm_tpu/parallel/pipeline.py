@@ -92,13 +92,15 @@ class PipelineEngine:
         cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
         devices: Optional[Sequence] = None,
-        quantize: Optional[str] = None,  # None | "int8" (weight-only)
+        quantize: Optional[str] = None,  # None | "int8" (weight-only) | "w8a8"
         samples_per_slot: int = 1,  # M: samples traveling together per ring slot
     ):
-        if quantize == "int8":
+        if quantize in ("int8", "w8a8"):
             from mdi_llm_tpu.ops.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(
+                params, mode="w8" if quantize == "int8" else "w8a8"
+            )
         elif quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         if cache_dtype is None:

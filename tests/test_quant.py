@@ -111,6 +111,52 @@ def test_generator_int8_close_to_fp32():
         assert a[: len(prompts[0]) + 2] == b[: len(prompts[0]) + 2]
 
 
+def test_w8a8_einsum_close_to_fp32():
+    """Dynamic activation quant + int8 matmul: error bounded by the combined
+    weight/activation rounding, across the plain and expert einsum shapes."""
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.normal(size=(2, 5, 32)).astype(np.float32))
+    for spec, wshape in (
+        ("...i,oi->...o", (16, 32)),
+        ("...i,ei->...e", (4, 32)),
+        ("...d,eid->...ei", (4, 16, 32)),
+    ):
+        w = rng.normal(size=wshape).astype(np.float32)
+        q, s = quantize_tensor(w)
+        p8 = {"weight_q8": jnp.asarray(q), "scale": jnp.asarray(s)}
+        got = np.asarray(quantized_einsum(spec, x, p8))
+        want = np.asarray(quantized_einsum(spec, x, {"weight": jnp.asarray(w)}))
+        err = got - want
+        # pointwise outliers are intrinsic at D=32 (quant noise ~ sqrt(D));
+        # the aggregate error must stay small
+        rms_ratio = np.sqrt((err**2).mean()) / np.sqrt((want**2).mean())
+        assert rms_ratio < 0.02 and np.max(np.abs(err)) < 0.5, (spec, rms_ratio)
+    # the trailing-contraction expert shape: x (..., E, I) @ (E, D, I)
+    xe = jnp.asarray(rng.normal(size=(2, 5, 4, 16)).astype(np.float32))
+    wp = rng.normal(size=(4, 32, 16)).astype(np.float32)
+    q, s = quantize_tensor(wp)
+    got = quantized_einsum(
+        "...ei,edi->...ed", xe, {"weight_q8": jnp.asarray(q), "scale": jnp.asarray(s)}
+    )
+    want = quantized_einsum("...ei,edi->...ed", xe, {"weight": jnp.asarray(wp)})
+    err = np.asarray(got) - np.asarray(want)
+    rms_ratio = np.sqrt((err**2).mean()) / np.sqrt((np.asarray(want) ** 2).mean())
+    assert rms_ratio < 0.02 and np.max(np.abs(err)) < 0.5
+
+
+def test_generator_w8a8_close_to_fp32():
+    cfg = tiny_cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    prompts = [[5, 9, 2, 7], [11, 3]]
+    g32 = Generator(cfg, params, rng_seed=7)
+    g8 = Generator(cfg, params, rng_seed=7, quantize="w8a8")
+    out32, _ = g32.generate(prompts, 8, temperature=0.0)
+    out8, _ = g8.generate(prompts, 8, temperature=0.0)
+    # coarser than weight-only: the first greedy tokens must still agree
+    for a, b, p in zip(out32, out8, prompts):
+        assert a[: len(p) + 2] == b[: len(p) + 2]
+
+
 def test_pipeline_engine_int8_runs(devices):
     from mdi_llm_tpu.parallel.pipeline import PipelineEngine
 
