@@ -91,6 +91,21 @@ def _bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
+def _cache_bucket(n: int, granularity: int = 256) -> int:
+    """KV-cache length bucket: finer-grained than the pow2 prompt buckets.
+    Decode attention reads the WHOLE cache buffer every step, so sizing it
+    to the run (prompt+max_new rounded up) instead of max_seq_length
+    directly cuts cache HBM traffic for short runs."""
+    return max(granularity, -(-n // granularity) * granularity)
+
+
+def _run_cache_len(max_seq_length: int, total_max: int, Tb: int) -> int:
+    """Cache length for one run: covers the generation horizon AND the
+    padded prompt bucket (prefill writes the whole Tb-wide chunk), capped at
+    the engine maximum.  Callers must clamp Tb <= max_seq_length."""
+    return min(max_seq_length, _cache_bucket(max(total_max, Tb)))
+
+
 # ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
@@ -298,14 +313,17 @@ class Generator:
                 f"{self.max_seq_length}; pass --sequence-length or shorten"
             )
 
-        Tb = _bucket(max_len)
+        # clamp the pow2 bucket at the engine max so a non-pow2
+        # max_seq_length can never leave the cache narrower than the chunk
+        Tb = min(_bucket(max_len), self.max_seq_length)
         batch = np.zeros((B, Tb), np.int32)
         for i, p in enumerate(prompts):
             batch[i, : lens[i]] = np.asarray(p, np.int32)
 
-        kv = transformer.init_kv_cache(
-            self.cfg, B, self.max_seq_length, dtype=self.cache_dtype
-        )
+        # cache sized to this run, not the engine maximum (jit retraces per
+        # cache shape; the 256-granularity keeps the shape set small)
+        cache_len = _run_cache_len(self.max_seq_length, total_max, Tb)
+        kv = transformer.init_kv_cache(self.cfg, B, cache_len, dtype=self.cache_dtype)
 
         stats = GenerationStats()
         t0 = time.perf_counter()
@@ -344,7 +362,7 @@ class Generator:
                 while (
                     n < max_new_tokens
                     and not done[0]
-                    and self.max_seq_length - int(positions[0]) - 1 >= K + 1
+                    and cache_len - int(positions[0]) - 1 >= K + 1
                 ):
                     draft = ngram_draft(out[0], K)
                     if not draft:
@@ -354,7 +372,7 @@ class Generator:
                         c = min(
                             chunk_size,
                             max_new_tokens - n,
-                            self.max_seq_length - int(positions[0]) - 1,
+                            cache_len - int(positions[0]) - 1,
                         )
                         toks_j, kv, self.key = self._decode_chunk_fn(1, c)(
                             self.params,
@@ -407,7 +425,7 @@ class Generator:
         # (≡ catch_loop_errors clean shutdown, context_managers.py:16-57)
         with catch_loop_errors() as guard:
             while n < max_new_tokens and not all(done) and not stats.interrupted:
-                room = self.max_seq_length - int(positions.max()) - 1
+                room = cache_len - int(positions.max()) - 1
                 k = min(chunk_size, max_new_tokens - n, room)
                 if k < 1:
                     break
@@ -471,10 +489,11 @@ class Generator:
         total_max = lens + max_new_tokens
         if total_max > self.max_seq_length:
             raise ValueError("prompt too long for max_seq_length")
-        Tb = _bucket(lens)
+        Tb = min(_bucket(lens), self.max_seq_length)
         batch = np.zeros((1, Tb), np.int32)
         batch[0, :lens] = np.asarray(prompt, np.int32)
-        kv = transformer.init_kv_cache(self.cfg, 1, self.max_seq_length, dtype=self.cache_dtype)
+        cache_len = _run_cache_len(self.max_seq_length, total_max, Tb)
+        kv = transformer.init_kv_cache(self.cfg, 1, cache_len, dtype=self.cache_dtype)
         last_logits, kv = self._prefill_fn(1, Tb)(
             self.params, jnp.asarray(batch), kv, jnp.asarray([lens], jnp.int32)
         )
@@ -490,7 +509,7 @@ class Generator:
             yield t
             if detect_stop_tokens(history, stop_sequences):
                 return
-            if i == max_new_tokens - 1 or int(pos[0]) + 1 >= self.max_seq_length:
+            if i == max_new_tokens - 1 or int(pos[0]) + 1 >= cache_len:
                 return
             tok_j, kv, self.key = decode(
                 self.params, jnp.asarray(tok)[:, None], kv, jnp.asarray(pos), self.key,

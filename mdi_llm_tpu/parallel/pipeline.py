@@ -49,6 +49,7 @@ from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
 from mdi_llm_tpu.generation import (
     GenerationStats,
     _bucket,
+    _run_cache_len,
     detect_stop_tokens,
     find_eot,
 )
@@ -156,14 +157,14 @@ class PipelineEngine:
     # state builders
     # ------------------------------------------------------------------
 
-    def _init_kv(self):
+    def _init_kv(self, seq_len: Optional[int] = None):
         shape = (
             self.n_stages,
             self.l_max,
             self.n_slots,
             self.M,
             self.cfg.n_query_groups,
-            self.max_seq_length,
+            seq_len or self.max_seq_length,
             self.cfg.head_size,
         )
         sh = NamedSharding(self.mesh, P("pipe"))
@@ -496,7 +497,7 @@ class PipelineEngine:
         # ---- initial batch: first S*M samples, packed into groups of M ----
         n_init = min(N, S * M)
         n_groups = -(-n_init // M)
-        Tb = _bucket(max(lens[:n_init]))
+        Tb = min(_bucket(max(lens[:n_init])), self.max_seq_length)
         prompts_np = np.zeros((n_groups, M, Tb), np.int32)
         lens_np = np.ones((n_groups, M), np.int32)
         valid_np = np.zeros((n_groups, M), np.int32)
@@ -506,7 +507,16 @@ class PipelineEngine:
             lens_np[g, m] = lens[i]
             valid_np[g, m] = 1
 
-        kv = self._init_kv()
+        # cache sized to this run (every ring micro-step reads whole cache
+        # slots, so shorter buffers directly cut HBM traffic); must cover
+        # both the generation horizon and any prompt bucket width (initial
+        # or batch-refill)
+        cache_len = _run_cache_len(
+            self.max_seq_length,
+            max(lens) + max_new_tokens,
+            min(_bucket(max(lens)), self.max_seq_length),
+        )
+        kv = self._init_kv(cache_len)
         dtype = transformer.param_dtype(self.stage_blocks)
 
         out = [list(p) for p in prompts]
@@ -587,7 +597,7 @@ class PipelineEngine:
             K = min(len(free), -(-len(queue) // M))
             take = queue[: K * M]
             del queue[: K * M]
-            Tb2 = _bucket(max(lens[j] for j in take))
+            Tb2 = min(_bucket(max(lens[j] for j in take)), self.max_seq_length)
             # pad the group count to a power of two so refill prefills hit a
             # bounded set of compiled shapes; padded groups are all-invalid
             # and write only the dummy cache slot

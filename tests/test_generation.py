@@ -82,6 +82,20 @@ def test_sequence_length_guard(small_model):
         gen.generate([[1] * 10], 20)
 
 
+def test_non_pow2_max_seq_prompt_bucket(small_model):
+    """A non-power-of-two max_seq_length with a prompt whose pow2 bucket
+    exceeds it must still generate (the bucket clamps to max_seq_length so
+    the run-sized cache always covers the prefill chunk)."""
+    cfg, params = small_model
+    gen = Generator(cfg, params, max_seq_length=50, cache_dtype=jnp.float32)
+    prompt = [1 + (i % 7) for i in range(40)]  # _bucket(40)=64 > 50
+    out, _ = gen.generate([prompt], 8, temperature=0.0)
+    assert len(out[0]) == 48
+    full = Generator(cfg, params, cache_dtype=jnp.float32)
+    want, _ = full.generate([prompt], 8, temperature=0.0)
+    assert out == want
+
+
 def test_speculative_matches_plain_greedy():
     """Speculative decoding must be token-identical to plain greedy decode,
     across accept/reject mixes (repetitive prompt -> long accepts; random
