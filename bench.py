@@ -60,7 +60,22 @@ def main():
     from mdi_llm_tpu.cli._common import resolve_kv_dtype
     kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
     cfg = Config.from_name(args.model)
-    params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    if args.quantize != "none":
+        # build the int8 tree directly: an 8B-class model never exists in
+        # f32/bf16, so Llama-3-8B fits one v5e chip for quantized benches
+        from mdi_llm_tpu.ops.quant import init_quantized_params
+
+        params = init_quantized_params(
+            cfg, mode="w8" if args.quantize == "int8" else "w8a8", dtype=dtype
+        )
+        if not args.pipeline:
+            # single-chip engine keeps the tree as-is: pin it on device once
+            # (PipelineEngine re-splits host-side and places per stage)
+            params = jax.device_put(params)
+        quantize = "none"  # engines receive pre-quantized params
+    else:
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        quantize = args.quantize
     rng = np.random.default_rng(0)
     prompts = [
         rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
@@ -85,7 +100,7 @@ def main():
             use_flash = use_flash and jax.default_backend() == "tpu"
             eng = Generator(
                 cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
-                use_flash=use_flash, quantize=args.quantize,
+                use_flash=use_flash, quantize=quantize,
             )
             outs, _ = eng.generate(prompts, 8, temperature=0.0)  # warmup+tokens
             best = float("inf")
@@ -125,7 +140,7 @@ def main():
             n_stages=args.pipeline,
             max_seq_length=args.seq_len,
             cache_dtype=kv_dtype,
-            quantize=args.quantize,
+            quantize=quantize,
             samples_per_slot=args.samples_per_slot,
         )
         label = f"pipeline{args.pipeline}" + (
@@ -136,15 +151,17 @@ def main():
 
         engine = Generator(
             cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
-            quantize=args.quantize,
+            quantize=quantize,
         )
         label = "batched-decode" + (
             f"+{args.quantize}" if args.quantize != "none" else ""
         )
 
     kwargs = {} if args.pipeline else {"chunk_size": args.chunk}
-    # warmup (compile)
-    engine.generate(prompts, min(args.chunk + 1, args.new_tokens), temperature=0.0, **kwargs)
+    # warmup with the run's own token budget: KV caches are sized to the run
+    # (prompt+max_new bucket), so a shorter warmup would compile a different
+    # cache shape and the timed run would recompile inside the measurement
+    engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
     t0 = time.perf_counter()
     outs, stats = engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
     wall = time.perf_counter() - t0

@@ -91,6 +91,67 @@ def quantize_params(
     return walk(params, "")
 
 
+def init_quantized_params(cfg, seed: int = 0, mode: str = "w8", dtype=None):
+    """Random ALREADY-QUANTIZED parameters for benchmarking large models:
+    builds the int8 linears directly (uniform int8 with scales chosen so the
+    dequantized std matches `init_params`, incl. the 1/sqrt(2L) output-proj
+    scaling) so an 8B-class model never exists in f32/bf16 — peak footprint
+    is the int8 tree itself.  Norms/embeddings/head are bf16 as in real
+    quantized checkpoints.  For throughput benchmarking, NOT accuracy work
+    (the int8 values are uniform, not rounded gaussians)."""
+    import ml_dtypes
+
+    if mode not in ("w8", "w8a8"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    wkey = "weight_q" if mode == "w8" else "weight_q8"
+    np_dtype = ml_dtypes.bfloat16 if dtype in (None, jnp.bfloat16) else np.dtype(dtype)
+    rng = np.random.default_rng(seed)
+    L, D, V, I = cfg.n_layer, cfg.n_embd, cfg.padded_vocab_size, cfg.intermediate_size
+    std = 0.02
+    proj_std = std / (2 * L) ** 0.5  # ≡ init_params output-projection scaling
+
+    def qlin(out_d, in_d, s=std):
+        q = rng.integers(-127, 128, size=(L, out_d, in_d), dtype=np.int8)
+        # per-channel scale so the dequantized std matches init_params
+        # (73.3 = rms of uniform int8 in [-127, 127])
+        return {wkey: q, "scale": np.full((L, out_d), s / 73.3, np.float32)}
+
+    def norm():
+        p = {"weight": np.ones((L, D), np_dtype)}
+        if cfg.norm_class_name == "LayerNorm" and cfg.bias:
+            p["bias"] = np.zeros((L, D), np_dtype)
+        return p
+
+    def emb(rows):
+        return (rng.standard_normal((rows, D)).astype(np.float32) * 0.02).astype(np_dtype)
+
+    attn = {
+        "qkv": qlin(cfg.qkv_size, D),
+        "proj": qlin(D, cfg.attn_out_size, proj_std),
+    }
+    if cfg.mlp_class_name == "GptNeoxMLP":
+        mlp = {"fc": qlin(I, D), "proj": qlin(D, I, proj_std)}
+    elif cfg.mlp_class_name in ("LLaMAMLP", "GemmaMLP"):
+        mlp = {
+            "fc_1": qlin(I, D),
+            "fc_2": qlin(I, D),
+            "proj": qlin(D, I, proj_std),
+        }
+    else:
+        raise NotImplementedError("init_quantized_params: MoE not needed for bench")
+    blocks = {"norm_1": norm(), "attn": attn, "mlp": mlp}
+    if not cfg.shared_attention_norm:
+        blocks["norm_2"] = norm()
+    params = {
+        "wte": {"weight": emb(V)},
+        "blocks": blocks,
+        "ln_f": {"weight": np.ones((D,), np_dtype)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"weight": emb(V)}
+    return params
+
+
 def quantized_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     """einsum against a (possibly) quantized weight dict.  `spec` contracts
     x with the stored (out, in)-layout weight; the per-out-channel scale is

@@ -168,6 +168,23 @@ def test_pipeline_engine_int8_runs(devices):
     assert stats.tokens_generated == 12
 
 
+def test_init_quantized_params_generates():
+    """Direct-to-int8 random init (large-model bench path): tree has the
+    quantized layout and drives the Generator end to end."""
+    from mdi_llm_tpu.ops.quant import init_quantized_params
+
+    cfg = tiny_cfg()
+    qp = init_quantized_params(cfg, seed=1, dtype=jnp.float32)
+    assert qp["blocks"]["attn"]["qkv"]["weight_q"].dtype == np.int8
+    assert "weight" in qp["wte"] and "weight_q" not in qp["wte"]
+    g = Generator(cfg, jax.device_put(qp), rng_seed=5, cache_dtype=jnp.float32)
+    outs, _ = g.generate([[5, 9, 2]], 6, temperature=0.0)
+    assert len(outs[0]) == 9
+
+    qp8 = init_quantized_params(cfg, seed=1, mode="w8a8", dtype=jnp.float32)
+    assert qp8["blocks"]["attn"]["qkv"]["weight_q8"].dtype == np.int8
+
+
 def test_moe_quantized_forward():
     cfg = tiny_cfg(
         mlp_class_name="LLaMAMoE", n_expert=4, n_expert_per_token=2, intermediate_size=32
