@@ -90,6 +90,26 @@ def test_sample_cli_pipeline_matches_single(tiny_ckpt, tmp_path, devices):
     assert piped == single
 
 
+def test_sample_cli_sp_matches_single(tiny_ckpt, devices):
+    from mdi_llm_tpu.cli.sample import main
+
+    common = [
+        "--ckpt", str(tiny_ckpt),
+        "--dtype", "float32",
+        "--n-samples", "2",
+        "--n-tokens", "5",
+        "--prompt", "lazy dog runs",
+        "--greedy",
+    ]
+    single = main(common)
+    sp = main(common + ["--sp-devices", "2"])
+    assert sp == single
+    with pytest.raises(SystemExit):
+        main(common + ["--sp-devices", "2", "--pipeline-stages", "2"])
+    with pytest.raises(SystemExit):
+        main(common + ["--sp-devices", "2", "--quantize", "int8"])
+
+
 def test_prepare_data_and_train_cli(tiny_ckpt, tmp_path):
     from mdi_llm_tpu.cli.prepare_data import main as prep_main
     from mdi_llm_tpu.cli.train import main as train_main
