@@ -49,7 +49,12 @@ def build_parser():
     ap.add_argument("--log-interval", type=int, default=10)
     ap.add_argument("--patience", type=int, default=5)
     ap.add_argument("--no-remat", action="store_true")
-    ap.add_argument("--mesh", default=None, help='e.g. "dp=8" or "dp=4,tp=2"')
+    ap.add_argument(
+        "--mesh",
+        default=None,
+        help='e.g. "dp=8", "dp=4,tp=2", "dp=2,sp=4" (ring attention), or '
+        '"dp=1,pp=4" (GPipe pipeline stages)',
+    )
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument("--num-processes", type=int, default=None)

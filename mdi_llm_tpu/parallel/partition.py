@@ -19,6 +19,9 @@ import json
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence
 
+import jax
+import numpy as np
+
 from mdi_llm_tpu.config import Config
 from mdi_llm_tpu.models.transformer import Params, slice_blocks
 
@@ -119,6 +122,34 @@ def split_params(
                     stage[k] = params[k]
         stages.append(stage)
     return stages
+
+
+def pad_stage_blocks(stages: List[Params], l_max: int):
+    """Zero-pad every stage's block stack to `l_max` layers and stack into
+    per-leaf arrays with a leading stage axis (S, l_max, ...).  Zero-weight
+    blocks are exact identities (residual adds zero), so no layer mask is
+    needed — the uniform shape keeps SPMD pipeline programs single-trace."""
+
+    def pad(leaf):
+        leaf = np.asarray(leaf)
+        pad_width = [(0, l_max - leaf.shape[0])] + [(0, 0)] * (leaf.ndim - 1)
+        return np.pad(leaf, pad_width)
+
+    padded = [jax.tree_util.tree_map(pad, s["blocks"]) for s in stages]
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *padded)
+
+
+def unpad_stage_blocks(stage_blocks: Params, counts: Sequence[int]) -> Params:
+    """Inverse of `split_params` + `pad_stage_blocks`: drop each stage's zero
+    padding and concatenate back into the standard stacked-(L, ...) layout."""
+
+    def unsplit(leaf):
+        leaf = np.asarray(leaf)
+        return np.concatenate(
+            [leaf[s, : counts[s]] for s in range(len(counts))], axis=0
+        )
+
+    return jax.tree_util.tree_map(unsplit, stage_blocks)
 
 
 def save_stage_manifest(out_dir, cfg: Config, n_stages: int, **kw) -> Path:

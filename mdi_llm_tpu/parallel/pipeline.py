@@ -57,22 +57,11 @@ from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.ops.sampling import sample
 from mdi_llm_tpu.utils.context_managers import catch_loop_errors
 from mdi_llm_tpu.parallel.mesh import pipeline_mesh
-from mdi_llm_tpu.parallel.partition import split_params, stage_layers
-
-
-def _pad_stage_blocks(stages: List[Any], l_max: int):
-    """Zero-pad every stage's block stack to `l_max` layers and stack into
-    per-leaf arrays with a leading stage axis (S, l_max, ...).  Zero-weight
-    blocks are exact identities (residual adds zero), so no layer mask is
-    needed."""
-
-    def pad(leaf):
-        leaf = np.asarray(leaf)
-        pad_width = [(0, l_max - leaf.shape[0])] + [(0, 0)] * (leaf.ndim - 1)
-        return np.pad(leaf, pad_width)
-
-    padded = [jax.tree_util.tree_map(pad, s["blocks"]) for s in stages]
-    return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *padded)
+from mdi_llm_tpu.parallel.partition import (
+    pad_stage_blocks as _pad_stage_blocks,
+    split_params,
+    stage_layers,
+)
 
 
 class PipelineEngine:

@@ -40,6 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from mdi_llm_tpu.config import Config
 from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.parallel.partition import pad_stage_blocks, unpad_stage_blocks
 from mdi_llm_tpu.parallel.sharding import param_specs
 from mdi_llm_tpu.utils import data_loader
 
@@ -124,7 +125,17 @@ def make_optimizer(tc: TrainingConfig) -> optax.GradientTransformation:
     cosine-with-warmup schedule baked in."""
 
     def decay_mask(params):
-        return jax.tree_util.tree_map(lambda p: p.ndim >= 2, params)
+        # the reference decays params with dim >= 2 in the UNSTACKED torch
+        # layout (train.py:254-261): true weight matrices only.  Our stacked
+        # layout makes per-layer norm weights (L, D) and biases (L, out)
+        # 2-D, so the rule is by path: weights outside norm subtrees.
+        def leaf_mask(path, p):
+            keys = {getattr(k, "key", None) for k in path}
+            if keys & {"norm_1", "norm_2", "ln_f"}:
+                return False
+            return getattr(path[-1], "key", None) == "weight" and p.ndim >= 2
+
+        return jax.tree_util.tree_map_with_path(leaf_mask, params)
 
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
@@ -174,7 +185,48 @@ class Trainer:
         self.optimizer = make_optimizer(tc)
 
         self.sp = mesh is not None and "sp" in mesh.axis_names
-        if mesh is not None:
+        self.pp = mesh is not None and "pp" in mesh.axis_names
+        if self.pp:
+            # GPipe-style pipeline-parallel training over a ("dp", "pp")
+            # mesh: stage-sharded blocks, microbatched ring forward
+            if self.sp or "tp" in mesh.axis_names:
+                raise ValueError("pp composes with dp only (pp×tp/sp: future work)")
+            S = int(mesh.shape["pp"])
+            self.pp_stages = S
+            # balanced split (NOT the inference table): the training ring
+            # runs embed+head on every stage anyway, and every stage scans
+            # l_max layers per micro-step — padded layers cost full FLOPs,
+            # so minimizing l_max = ceil(L/S) is what matters here
+            base, rem = divmod(cfg.n_layer, S)
+            self.pp_counts = [base + (1 if s >= S - rem else 0) for s in range(S)]
+            self.pp_lmax = max(self.pp_counts)
+            dp_size = int(mesh.shape.get("dp", 1))
+            if tc.batch_size % (dp_size * S):
+                raise ValueError(
+                    f"pp training microbatches each dp shard over the stages: "
+                    f"batch_size {tc.batch_size} must divide by dp×pp="
+                    f"{dp_size * S}"
+                )
+            stages = self._split_balanced(params)
+            pp_params: Dict[str, Any] = {
+                "stage_blocks": pad_stage_blocks(stages, self.pp_lmax)
+            }
+            for k in ("wte", "wpe", "ln_f", "lm_head"):
+                if k in params:
+                    pp_params[k] = params[k]
+            params = jax.tree_util.tree_map(jnp.asarray, pp_params)
+            pspecs = jax.tree_util.tree_map(lambda _: P(), params)
+            pspecs["stage_blocks"] = jax.tree_util.tree_map(
+                lambda _: P("pp"), params["stage_blocks"]
+            )
+            self.param_shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), pspecs
+            )
+            params = jax.tree_util.tree_map(
+                jax.device_put, params, self.param_shardings
+            )
+            self.batch_sharding = NamedSharding(mesh, P("dp"))
+        elif mesh is not None:
             # sequence parallelism uses explicit shard_map collectives; params
             # stay replicated there (tp+sp composition is future work)
             tp = "tp" if ("tp" in mesh.axis_names and not self.sp) else None
@@ -228,10 +280,97 @@ class Trainer:
             out_specs=P(),
         )
 
+    def _pp_loss_fn(self):
+        """GPipe-style pipeline-parallel loss: shard_map over ("dp", "pp").
+
+        The batch splits into S microbatches; the ring runs S + S - 1
+        lockstep micro-steps where stage s processes microbatch t - s and
+        `ppermute`s its activation downstream (the training analog of the
+        inference ring in parallel/pipeline.py).  The last stage's emitted
+        activations feed final-norm/head/CE once; `jax.grad` differentiates
+        through the scan and ppermute (transpose = reverse permute), giving
+        the 1F1B-equivalent backward for free.  Zero-padded stage layers are
+        exact identities and receive zero gradients, and AdamW keeps them at
+        zero (masked decay, zero moments)."""
+        cfg, tc, mesh = self.cfg, self.tc, self.mesh
+        S = self.pp_stages
+        n_micro = S
+
+        def local_loss(params, x, y):
+            blocks = jax.tree_util.tree_map(
+                lambda a: a[0], params["stage_blocks"]
+            )  # strip the local stage axis
+            d = jax.lax.axis_index("pp")
+            B, T = x.shape
+            mu = B // n_micro
+            xm = x.reshape(n_micro, mu, T)
+            pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (mu, T))
+            rope = transformer.get_rope_cache(cfg)
+            cos = jnp.take(jnp.asarray(rope[0]), pos, axis=0)
+            sin = jnp.take(jnp.asarray(rope[1]), pos, axis=0)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            n_steps = n_micro + S - 1
+            emb_dtype = transformer.param_dtype(params)
+
+            def step(x_act, t):
+                mb = t - d
+                active = (mb >= 0) & (mb < n_micro)
+                mb_c = jnp.clip(mb, 0, n_micro - 1)
+                x0 = transformer.embed(cfg, params, xm[mb_c], pos)
+                xin = jnp.where(d == 0, x0.astype(x_act.dtype), x_act)
+                y_out, _ = transformer.run_blocks(
+                    cfg, blocks, xin, pos, cos, sin, remat=tc.remat
+                )
+                y_out = jnp.where(active, y_out, jnp.zeros_like(y_out))
+                return jax.lax.ppermute(y_out, "pp", perm), y_out
+
+            # the carry becomes device-varying after the first ppermute; a
+            # fresh-zeros carry would type as unvarying and fail the scan
+            x0c = jax.lax.pvary(
+                jnp.zeros((mu, T, cfg.n_embd), emb_dtype), ("dp", "pp")
+            )
+            _, emitted = jax.lax.scan(
+                step, x0c, jnp.arange(n_steps, dtype=jnp.int32)
+            )
+            # stage S-1 processed microbatch m at micro-step m + S - 1
+            outs = emitted[S - 1 : S - 1 + n_micro].reshape(B, T, cfg.n_embd)
+            logits = transformer.head(cfg, params, outs).astype(jnp.float32)
+            losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            def psum_all(v):
+                # pvary exactly the axes the value does not already vary on
+                # (e.g. losses.size is a constant, invarying on both)
+                have = getattr(jax.typeof(v), "vma", frozenset())
+                need = tuple(a for a in ("dp", "pp") if a not in have)
+                if need:
+                    v = jax.lax.pvary(v, need)
+                return jax.lax.psum(v, ("dp", "pp"))
+
+            is_last = (d == S - 1).astype(jnp.float32)
+            total = psum_all(losses.sum() * is_last)
+            count = psum_all(jnp.asarray(losses.size, jnp.float32) * is_last)
+            return total / count
+
+        pspec = jax.tree_util.tree_map(lambda _: P(), self.params)
+        pspec["stage_blocks"] = jax.tree_util.tree_map(
+            lambda _: P("pp"), self.params["stage_blocks"]
+        )
+        return jax.shard_map(
+            local_loss,
+            mesh=mesh,
+            in_specs=(pspec, P("dp"), P("dp")),
+            out_specs=P(),
+        )
+
     def _build_step(self):
         cfg, tc = self.cfg, self.tc
 
-        if self.sp:
+        if self.pp:
+            pp_loss = self._pp_loss_fn()
+
+            def loss_fn(params, x, y):
+                return pp_loss(params, x, y)
+
+        elif self.sp:
             sp_loss = self._sp_loss_fn()
 
             def loss_fn(params, x, y):
@@ -274,7 +413,9 @@ class Trainer:
     def _build_eval(self):
         cfg = self.cfg
 
-        if self.sp:
+        if self.pp:
+            ev = self._pp_loss_fn()
+        elif self.sp:
             ev = self._sp_loss_fn()
         else:
 
@@ -374,6 +515,67 @@ class Trainer:
     # train.py:166-186,290-311)
     # ------------------------------------------------------------------
 
+    def _split_balanced(self, params_like):
+        """Slice a standard params-shaped tree into balanced pp stages
+        (same mechanics as partition.split_params, balanced pp_counts)."""
+        stages = []
+        lo = 0
+        for s, c in enumerate(self.pp_counts):
+            stage = {
+                "blocks": jax.tree_util.tree_map(
+                    lambda x: x[lo : lo + c], params_like["blocks"]
+                )
+            }
+            if s == 0:
+                for k in ("wte", "wpe", "ln_f", "lm_head"):
+                    if k in params_like:
+                        stage[k] = params_like[k]
+            stages.append(stage)
+            lo += c
+        return stages
+
+    def _pp_tree_to_standard(self, tree):
+        std = {k: v for k, v in tree.items() if k != "stage_blocks"}
+        std["blocks"] = unpad_stage_blocks(
+            jax.device_get(tree["stage_blocks"]), self.pp_counts
+        )
+        return std
+
+    def _pp_tree_from_standard(self, tree):
+        pp = {k: v for k, v in tree.items() if k != "blocks"}
+        pp["stage_blocks"] = pad_stage_blocks(
+            self._split_balanced(tree), self.pp_lmax
+        )
+        return jax.tree_util.tree_map(jax.device_put, pp, self.param_shardings)
+
+    def _map_param_subtrees(self, state, fn, marker):
+        """Apply `fn` to every params-shaped subtree (a dict containing
+        `marker`) inside an optax state (tuples / NamedTuples / dicts)."""
+
+        def walk(node):
+            if isinstance(node, dict):
+                if marker in node:
+                    return fn(node)
+                return {k: walk(v) for k, v in node.items()}
+            if isinstance(node, tuple):
+                cls = type(node)
+                if hasattr(node, "_fields"):  # NamedTuple (optax states)
+                    return cls(*(walk(c) for c in node))
+                return cls(walk(c) for c in node)
+            if isinstance(node, list):
+                return [walk(c) for c in node]
+            return node
+
+        return walk(state)
+
+    def _standard_params(self):
+        """Params in the standard stacked-(L, ...) layout, regardless of the
+        training-time partitioning (pp stage layout is unsplit for
+        checkpoints so they interop with every other component)."""
+        if not self.pp:
+            return self.params
+        return self._pp_tree_to_standard(self.params)
+
     def save(self, out_dir) -> Path:
         import orbax.checkpoint as ocp
         from flax import serialization
@@ -384,11 +586,17 @@ class Trainer:
         if p.exists():
             shutil.rmtree(p)
         with ocp.PyTreeCheckpointer() as ck:
-            ck.save(p, self.params)
+            ck.save(p, self._standard_params())
         # optimizer state holds NamedTuples — msgpack with a structure
-        # template on restore keeps it exact
+        # template on restore keeps it exact; pp moments are unsplit to the
+        # standard layout (same interop rule as the params)
+        opt_state = self.opt_state
+        if self.pp:
+            opt_state = self._map_param_subtrees(
+                opt_state, self._pp_tree_to_standard, "stage_blocks"
+            )
         (out_dir / "opt_state.msgpack").write_bytes(
-            serialization.to_bytes(self.opt_state)
+            serialization.to_bytes(opt_state)
         )
         self.cfg.save(out_dir)
         (out_dir / "train_state.json").write_text(
@@ -414,9 +622,19 @@ class Trainer:
         with ocp.PyTreeCheckpointer() as ck:
             params = ck.restore(out_dir / "params")
         tr = cls(cfg, tc, mesh=mesh, params=params, out_dir=out_dir)
-        tr.opt_state = serialization.from_bytes(
-            tr.opt_state, (out_dir / "opt_state.msgpack").read_bytes()
-        )
+        raw = (out_dir / "opt_state.msgpack").read_bytes()
+        if tr.pp:
+            # on-disk moments use the standard layout; repartition on load
+            template = tr._map_param_subtrees(
+                tr.opt_state, tr._pp_tree_to_standard, "stage_blocks"
+            )
+            tr.opt_state = tr._map_param_subtrees(
+                serialization.from_bytes(template, raw),
+                tr._pp_tree_from_standard,
+                "blocks",
+            )
+        else:
+            tr.opt_state = serialization.from_bytes(tr.opt_state, raw)
         tr.iter_num = state["iter_num"]
         tr.best_val_loss = state["best_val_loss"]
         return tr
