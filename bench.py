@@ -43,7 +43,12 @@ def main():
     ap.add_argument("--dtype", choices=("bfloat16", "float16", "float32"), default="bfloat16")
     ap.add_argument("--quantize", choices=("none", "int8", "w8a8"), default="none")
     ap.add_argument("--kv-dtype", choices=("auto", "bfloat16", "float16", "float32", "float8"), default="auto")
-    ap.add_argument("--chunk", type=int, default=128, help="decode steps per jit call")
+    ap.add_argument(
+        "--chunk", type=int, default=128,
+        help="decode steps per jit call (pipeline mode: steady-state ring "
+        "rotations per jit call — prefer ~16 for runs with early-stopping "
+        "samples; surplus rotations after a mid-chunk finish are discarded)",
+    )
     ap.add_argument(
         "--mode", choices=("decode", "prefill"), default="decode",
         help="prefill: compare flash-attention prefill latency vs the XLA "
@@ -89,10 +94,22 @@ def main():
 
         if args.pipeline:
             raise SystemExit("--mode prefill benches the single-chip engine; drop --pipeline")
+        if args.quantize != "none":
+            raise SystemExit(
+                "--mode prefill compares against an f32 reference forward, "
+                "which does not exist for a quantized tree; drop --quantize"
+            )
         if args.prompt_len < 256:
             raise SystemExit(
                 "--mode prefill needs --prompt-len >= 256 (the flash kernel "
                 "only engages above the small-tile threshold)"
+            )
+        limit = min(args.seq_len, cfg.block_size)
+        if args.prompt_len >= limit:
+            raise SystemExit(
+                f"--prompt-len {args.prompt_len} must leave generation room "
+                f"below min(--seq-len, context window) = {limit}; positions "
+                "past the RoPE cache would be garbage"
             )
         if jax.default_backend() != "tpu":
             print("warning: flash kernel needs TPU; both runs use the XLA path",
@@ -103,17 +120,73 @@ def main():
             eng = Generator(
                 cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
                 use_flash=use_flash, quantize=quantize,
+                # force the comparison at exactly --prompt-len (the engine's
+                # auto threshold would silently fall back to XLA below 2k)
+                flash_min_len=256,
             )
-            outs, _ = eng.generate(prompts, 8, temperature=0.0)  # warmup+tokens
+            eng.generate(prompts, 1, temperature=0.0)  # warmup
             best = float("inf")
             for _ in range(3):
                 _, stats = eng.generate(prompts, 1, temperature=0.0)
                 best = min(best, stats.prefill_s)
-            return best, outs
+            return best
 
-        t_flash, toks_flash = best_prefill(True)
-        t_xla, toks_xla = best_prefill(False)
-        assert toks_flash == toks_xla, "flash prefill diverged from XLA tokens"
+        # Numerics: the two attention implementations accumulate in different
+        # orders, so bf16 token identity is not a meaningful invariant
+        # (near-tie argmax flips are expected, especially on random weights
+        # whose logits are near-uniform).  The meaningful check: flash must
+        # be no less accurate than the XLA path against an f32 reference
+        # forward (measured r3 on v5e: flash 0.0297 vs xla 0.0303 rel err —
+        # statistically identical).
+        batch_np = np.zeros((args.batch, args.prompt_len), np.int32)
+        for i, p in enumerate(prompts):
+            batch_np[i] = np.asarray(p, np.int32)
+
+        # device-side reductions over the last <=512 prompt positions: full
+        # (B, T, vocab) f32 logit tensors pulled to host would be multi-GB at
+        # the shapes where flash matters
+        n_check = min(args.prompt_len, 512)
+
+        def prompt_logits(run_params, run_dtype, use_flash):
+            kv0 = transformer.init_kv_cache(
+                cfg, args.batch, args.prompt_len, dtype=run_dtype
+            )
+
+            def fwd(pr, t, kv):
+                logits, _ = transformer.forward(
+                    cfg, pr, t, jnp.zeros((args.batch,), jnp.int32), kv=kv,
+                    fresh_prefill=True,
+                    use_flash=use_flash and jax.default_backend() == "tpu",
+                )
+                # slice inside the jit so only the checked tail is ever
+                # materialized (full (B,T,vocab) f32 is multi-GB at the
+                # shapes where flash matters)
+                return logits[:, -n_check:].astype(jnp.float32)
+
+            return jax.jit(fwd)(run_params, jnp.asarray(batch_np), kv0)
+
+        params_f32 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32), params
+        )
+        lg_ref = prompt_logits(params_f32, jnp.float32, False)
+        del params_f32
+        scale_ = max(1e-6, float(jnp.max(jnp.abs(lg_ref))))
+
+        def check(use_flash):
+            lg = prompt_logits(params, kv_dtype, use_flash)
+            err = float(jnp.max(jnp.abs(lg - lg_ref))) / scale_
+            return err, jnp.argmax(lg, -1)
+
+        err_f, am_f = check(True)
+        err_x, am_x = check(False)
+        del lg_ref
+        agree = float(jnp.mean(am_f == am_x))
+        assert err_f <= err_x * 1.5 + 1e-3, (
+            f"flash prefill less accurate than XLA: {err_f} vs {err_x}"
+        )
+
+        t_flash = best_prefill(True)
+        t_xla = best_prefill(False)
         print(
             json.dumps(
                 {
@@ -125,7 +198,9 @@ def main():
                         "flash_ms": round(t_flash * 1000, 2),
                         "xla_ms": round(t_xla * 1000, 2),
                         "flash_speedup": round(t_xla / t_flash, 2),
-                        "tokens_agree": True,
+                        "flash_rel_err_vs_f32": round(err_f, 5),
+                        "xla_rel_err_vs_f32": round(err_x, 5),
+                        "argmax_agreement_bf16": round(agree, 5),
                         "device": str(jax.devices()[0]),
                     },
                 }
@@ -144,6 +219,7 @@ def main():
             cache_dtype=kv_dtype,
             quantize=quantize,
             samples_per_slot=args.samples_per_slot,
+            rotations_per_call=args.chunk,
         )
         label = f"pipeline{args.pipeline}" + (
             f"xM{args.samples_per_slot}" if args.samples_per_slot > 1 else ""

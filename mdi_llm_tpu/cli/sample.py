@@ -140,6 +140,7 @@ def main(argv=None):
                 rng_seed=args.seed, quantize=args.quantize,
                 cache_dtype=resolve_kv_dtype(args.kv_dtype),
                 samples_per_slot=args.samples_per_slot,
+                rotations_per_call=args.chunk,
             )
             n_nodes = args.pipeline_stages
             outs, stats = engine.generate(

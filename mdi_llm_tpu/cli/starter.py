@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="samples batched per ring slot (M): full utilization serves "
         "stages×M concurrent samples",
     )
+    ap.add_argument(
+        "--chunk",
+        type=int,
+        default=16,
+        help="steady-state ring rotations per jit dispatch",
+    )
     return ap
 
 
@@ -121,6 +127,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
                 or jax.device_count()
             ),
             samples_per_slot=args.samples_per_slot,
+            rotations_per_call=args.chunk,
         )
         spec = broadcast_run_spec(spec)
     else:
@@ -143,6 +150,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         quantize=spec["quantize"],
         cache_dtype=resolve_kv_dtype(spec["kv_dtype"]),
         samples_per_slot=spec.get("samples_per_slot", 1),
+        rotations_per_call=spec.get("rotations_per_call", 16),
     )
     t0 = time.perf_counter()
     outs, stats = engine.generate(

@@ -142,6 +142,7 @@ class Generator:
         cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
         use_flash: Optional[bool] = None,  # None → auto (TPU backend)
+        flash_min_len: int = 2048,  # engage flash at prompt buckets >= this
         quantize: Optional[str] = None,  # None | "int8" (weight-only) |
         # "w8a8" (dynamic activation quant, full int8 MXU matmuls)
     ):
@@ -161,6 +162,10 @@ class Generator:
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"
         self.use_flash = use_flash
+        # v5e r3 measurements (TinyLlama bf16): XLA's fused attention wins
+        # below ~2k (135 vs 145 ms at T=1024); flash wins 1.13x at T=2040
+        # and its edge grows with the T^2 term.  Short buckets stay on XLA.
+        self.flash_min_len = int(flash_min_len)
         self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
         self.cache_dtype = cache_dtype
         self.rope = transformer.get_rope_cache(cfg)
@@ -184,8 +189,8 @@ class Generator:
                     kv=kv,
                     rope=self.rope,
                     fresh_prefill=True,
-                    # flash pays off on big tiles; tiny buckets stay on XLA
-                    use_flash=self.use_flash and T >= 256,
+                    # flash pays off on big tiles; small buckets stay on XLA
+                    use_flash=self.use_flash and T >= self.flash_min_len,
                 )
                 last = jnp.take_along_axis(
                     logits, (true_len - 1)[:, None, None], axis=1

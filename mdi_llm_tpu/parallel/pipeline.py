@@ -84,6 +84,7 @@ class PipelineEngine:
         devices: Optional[Sequence] = None,
         quantize: Optional[str] = None,  # None | "int8" (weight-only) | "w8a8"
         samples_per_slot: int = 1,  # M: samples traveling together per ring slot
+        rotations_per_call: int = 16,  # steady-state ring rotations per jit call
     ):
         if quantize in ("int8", "w8a8"):
             from mdi_llm_tpu.ops.quant import quantize_params
@@ -134,6 +135,11 @@ class PipelineEngine:
         self.M = int(samples_per_slot)
         if self.M < 1:
             raise ValueError("samples_per_slot must be >= 1")
+        # Steady-state decode batches this many full ring rotations into one
+        # jit call (the override scan axis is simply R*S micro-steps long),
+        # amortizing host dispatch — critical when the chip sits behind an
+        # RPC tunnel, the same economics as Generator's chunk_size.
+        self.rotations_per_call = max(1, int(rotations_per_call))
         self.n_slots = S + 1  # one cache slot per ring position + dummy
         # Multi-node jobs (cli/starter.py + cli/secondary.py): every process
         # must be able to read the emitted tokens, so the ring all-gathers
@@ -141,6 +147,7 @@ class PipelineEngine:
         self.multiprocess = jax.process_count() > 1
         self._prefill_jit: Dict[Tuple, Any] = {}
         self._decode_jit: Dict[Tuple, Any] = {}
+        self._empty_chunk_cache: Dict[int, Any] = {}
 
     # ------------------------------------------------------------------
     # state builders
@@ -469,6 +476,18 @@ class PipelineEngine:
             "val": np.zeros((S, M), np.int32),
         }
 
+    def _empty_chunk_dev(self, n_rot: int):
+        """Device-resident empty overrides covering n_rot full rotations
+        (the decode ring scans the override leading axis, so R rotations is
+        just an R*S-long micro-step axis); uploaded once per R."""
+        if n_rot not in self._empty_chunk_cache:
+            ov = self._empty_overrides()
+            self._empty_chunk_cache[n_rot] = {
+                k: jnp.asarray(np.concatenate([v] * n_rot, axis=0))
+                for k, v in ov.items()
+            }
+        return self._empty_chunk_cache[n_rot]
+
     def _generate_continuous(
         self, prompts, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
     ):
@@ -573,7 +592,7 @@ class PipelineEngine:
         decode = self._get_decode(temperature, top_k, top_p)
         payload = None  # built by the first re-seed
         # empty overrides are constant: upload once, reuse when nothing fills
-        empty_dev = {k: jnp.asarray(v) for k, v in self._empty_overrides().items()}
+        empty_dev = self._empty_chunk_dev(1)
 
         def batch_refills():
             """Parallel-prefill queued prompts into fully-free slots (whole
@@ -688,8 +707,12 @@ class PipelineEngine:
 
         need_reseed = True  # initial seeding uses the same re-seed path
         # hard bound on rotations (scheduler-bug backstop: every sample costs
-        # at most lens + max_new_tokens rotations, plus seeding and drain)
-        max_rot = 2 + 2 * S + N + sum(l + max_new_tokens for l in lens)
+        # at most lens + max_new_tokens rotations, plus seeding and drain,
+        # plus up to one chunk of overshoot per sample finishing mid-chunk)
+        max_rot = (
+            2 + 2 * S + N + sum(l + max_new_tokens for l in lens)
+            + N * self.rotations_per_call
+        )
         # Ctrl-C mid-ring returns partial results (single-process; in a
         # multi-process job an interrupt tears down the whole SPMD group)
         with catch_loop_errors() as guard:
@@ -706,18 +729,35 @@ class PipelineEngine:
                 if not (active or filling):
                     continue  # everything finished during prefill; the while
                     # condition re-checks the queue (refills strictly drain it)
+                n_rot = 1
                 if need_reseed:
                     fed_prev = {}
                     payload = self._init_payload(1, dtype)
                     ov_dev, fed_cur = build_reseed_ov()
                     need_reseed = False
-                else:
+                elif filling:
                     fed_prev = fed_cur
                     ov, fed_cur = build_step_ov()
                     ov_dev = (
                         ov if ov is empty_dev
                         else {k: jnp.asarray(v) for k, v in ov.items()}
                     )
+                else:
+                    # steady state (no refills pending): every surviving lane
+                    # auto-feeds its own sampled token inside the jit, so R
+                    # rotations can run in one dispatch with empty overrides.
+                    # The lane->sample map is constant across the chunk; a
+                    # sample finishing mid-chunk just has its surplus tokens
+                    # discarded (same tradeoff as Generator chunk_size).
+                    # Bounded by the largest remaining budget (no lane can
+                    # accept more), floored to a power of two so the set of
+                    # compiled scan lengths stays small.
+                    maxbud = max(budget(j) for j in active.values())
+                    n_rot = max(1, min(self.rotations_per_call, maxbud))
+                    n_rot = 1 << (n_rot.bit_length() - 1)
+                    fed_prev = {**fed_cur, **dict(active)}
+                    fed_cur = fed_prev
+                    ov_dev = self._empty_chunk_dev(n_rot)
                 self.key, sub = jax.random.split(self.key)
                 kv, payload, emits = decode(
                     self.stage_blocks,
@@ -728,7 +768,7 @@ class PipelineEngine:
                     ov_dev,
                     sub,
                 )
-                stats.rotations += 1
+                stats.rotations += n_rot
 
                 # collect tokens fed one rotation ago
                 toks_e, sids_e, vals_e = self._stage0_emits(emits)

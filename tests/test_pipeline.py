@@ -130,8 +130,13 @@ def test_pipeline_continuous_beats_waves(model, single_engine, devices):
     README.md:33-37)."""
     cfg, params = model
     NEW = 20
+    # rotations_per_call=1 isolates the scheduling policy: the default
+    # steady-state chunking trades surplus rotations for fewer dispatches,
+    # which is invisible here (rotation counts are the metric, wall time is
+    # what chunking buys)
     eng = PipelineEngine(
-        cfg, params, mesh=pipeline_mesh(2, devices[:2]), cache_dtype=jnp.float32
+        cfg, params, mesh=pipeline_mesh(2, devices[:2]), cache_dtype=jnp.float32,
+        rotations_per_call=1,
     )
     pool = [[3, 1, 4], [2, 7, 18], [9, 9, 9], [6, 2], [11, 5], [8, 13, 21]]
     free = _single(single_engine, pool, NEW)
