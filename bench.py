@@ -43,11 +43,14 @@ def main():
     ap.add_argument("--dtype", choices=("bfloat16", "float16", "float32"), default="bfloat16")
     ap.add_argument("--quantize", choices=("none", "int8", "w8a8"), default="none")
     ap.add_argument("--kv-dtype", choices=("auto", "bfloat16", "float16", "float32", "float8"), default="auto")
+    # decode default 256 measured 2283 tok/s/chip vs 2133 at 128 (v5e, r3):
+    # longer scans amortize the host sync between dispatches.  Pipeline mode
+    # defaults to 16: surplus ring rotations after a mid-chunk sample finish
+    # are discarded, so long chunks deflate runs with early-stopping samples.
     ap.add_argument(
-        "--chunk", type=int, default=128,
-        help="decode steps per jit call (pipeline mode: steady-state ring "
-        "rotations per jit call — prefer ~16 for runs with early-stopping "
-        "samples; surplus rotations after a mid-chunk finish are discarded)",
+        "--chunk", type=int, default=None,
+        help="decode steps per jit call (default 256; pipeline mode: "
+        "steady-state ring rotations per jit call, default 16)",
     )
     ap.add_argument(
         "--mode", choices=("decode", "prefill"), default="decode",
@@ -55,6 +58,8 @@ def main():
         "path at --prompt-len and verify greedy-token agreement",
     )
     args = ap.parse_args()
+    if args.chunk is None:
+        args.chunk = 16 if args.pipeline else 256
 
     from mdi_llm_tpu.config import Config
     from mdi_llm_tpu.models import transformer
