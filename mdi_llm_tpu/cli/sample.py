@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="sequence-parallel inference over N devices: ring-attention "
         "prefill + sequence-sharded KV cache (context scales with N)",
     )
+    ap.add_argument(
+        "--tp-devices",
+        type=int,
+        default=0,
+        help="tensor-parallel inference over N devices (GSPMD Megatron "
+        "sharding; weights and KV heads split across chips)",
+    )
     # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
     ap.add_argument("--process-id", type=int, default=None)
@@ -105,6 +112,12 @@ def main(argv=None):
                 "--speculative requires --greedy (or --temperature 0) and "
                 "--n-samples 1"
             )
+    if args.tp_devices and (args.pipeline_stages or args.sp_devices):
+        raise SystemExit(
+            "--tp-devices is exclusive with --pipeline-stages and --sp-devices"
+        )
+    if args.tp_devices < 0:
+        raise SystemExit("--tp-devices must be a positive device count")
     seq_len = args.sequence_length
 
     from mdi_llm_tpu.utils.profiling import profile
@@ -150,11 +163,22 @@ def main(argv=None):
         else:
             from mdi_llm_tpu.generation import Generator
 
+            mesh = None
+            n_nodes = 1
+            if args.tp_devices:
+                if args.quantize not in (None, "none"):
+                    raise SystemExit("--quantize is not supported with --tp-devices yet")
+                from mdi_llm_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh(
+                    {"tp": args.tp_devices}, jax.devices()[: args.tp_devices]
+                )
+                n_nodes = args.tp_devices
             engine = Generator(
                 cfg, params, max_seq_length=seq_len, rng_seed=args.seed,
                 quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
+                mesh=mesh,
             )
-            n_nodes = 1
             outs, stats = engine.generate(
                 prompt_ids, args.n_tokens, temperature=temperature,
                 top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,

@@ -32,6 +32,8 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
 from mdi_llm_tpu.models import transformer
@@ -145,8 +147,20 @@ class Generator:
         flash_min_len: int = 2048,  # engage flash at prompt buckets >= this
         quantize: Optional[str] = None,  # None | "int8" (weight-only) |
         # "w8a8" (dynamic activation quant, full int8 MXU matmuls)
+        mesh: Optional[Mesh] = None,  # GSPMD dp/tp mesh: params laid out
+        # under parallel/sharding.py's Megatron rules, XLA inserts the
+        # collectives (beyond reference parity — the reference has no
+        # tensor-parallel inference at all, SURVEY.md §2.4)
     ):
         self.cfg = cfg
+        self.mesh = mesh
+        self._kv_sharding = None
+        self._dp = 1
+        if mesh is not None and quantize not in (None, "none"):
+            raise ValueError(
+                "quantized trees use custom leaf names the GSPMD sharding "
+                "rules don't cover; drop mesh or quantize"
+            )
         if quantize in ("int8", "w8a8"):
             from mdi_llm_tpu.ops.quant import quantize_params
 
@@ -156,6 +170,38 @@ class Generator:
             params = jax.device_put(quantize_params(params, mode=mode))
         elif quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
+        if mesh is not None:
+            from mdi_llm_tpu.parallel.sharding import shard_params
+
+            tp_n = int(mesh.shape.get("tp", 1))
+            dp_n = int(mesh.shape.get("dp", 1))
+            if tp_n > 1:
+                bad = [
+                    name
+                    for name, dim in (
+                        ("n_head", cfg.n_head),
+                        ("n_query_groups", cfg.n_query_groups),
+                        ("padded_vocab_size", cfg.padded_vocab_size),
+                        ("intermediate_size", cfg.intermediate_size),
+                    )
+                    if dim % tp_n
+                ]
+                if bad:
+                    raise ValueError(
+                        f"tp={tp_n} does not divide {', '.join(bad)} of "
+                        f"{cfg.name}"
+                    )
+            params = shard_params(params, cfg, mesh, "tp" if tp_n > 1 else None)
+            self._dp = dp_n
+            # KV cache (L, B, G, S, hs): batch on dp, KV groups on tp
+            self._kv_sharding = NamedSharding(
+                mesh,
+                P(
+                    None,
+                    "dp" if dp_n > 1 else None,
+                    "tp" if tp_n > 1 else None,
+                ),
+            )
         self.params = params
         if cache_dtype is None:
             cache_dtype = transformer.param_dtype(params)
@@ -173,6 +219,12 @@ class Generator:
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._decode_fns: Dict[int, Any] = {}
         self._decode_chunk_fns: Dict[Tuple[int, int], Any] = {}
+
+    def _place_kv(self, kv):
+        """Lay a fresh KV cache over the inference mesh (no-op without one)."""
+        if self._kv_sharding is None:
+            return kv
+        return jax.device_put(kv, self._kv_sharding)
 
     # -- compiled phases -----------------------------------------------------
 
@@ -325,10 +377,17 @@ class Generator:
         for i, p in enumerate(prompts):
             batch[i, : lens[i]] = np.asarray(p, np.int32)
 
+        if B % self._dp:
+            raise ValueError(
+                f"batch of {B} samples must be divisible by the mesh's "
+                f"dp={self._dp}"
+            )
         # cache sized to this run, not the engine maximum (jit retraces per
         # cache shape; the 256-granularity keeps the shape set small)
         cache_len = _run_cache_len(self.max_seq_length, total_max, Tb)
-        kv = transformer.init_kv_cache(self.cfg, B, cache_len, dtype=self.cache_dtype)
+        kv = self._place_kv(
+            transformer.init_kv_cache(self.cfg, B, cache_len, dtype=self.cache_dtype)
+        )
 
         stats = GenerationStats()
         t0 = time.perf_counter()
@@ -476,18 +535,27 @@ class Generator:
         model.py:526-573): yields tokens as they are sampled, buffering
         potential stop-sequence prefixes so a partial stop marker is never
         emitted."""
-        max_stop = max((len(s) for s in stop_sequences), default=0)
-        pending: List[int] = []
-        for t in self._generate_stream(
-            prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
-        ):
-            pending.append(t)
-            if detect_stop_tokens(pending, stop_sequences):
-                return
-            # hold back max_stop-1 tokens that could begin a stop sequence
-            while len(pending) > max(0, max_stop - 1):
-                yield pending.pop(0)
-        yield from pending
+        # validate at call time: this method returns an inner generator, so
+        # putting a raise in a generator body would defer it to the first
+        # next(), after the caller may already be streaming
+        if self._dp > 1:
+            raise ValueError("streaming generates one sample; use a tp-only mesh")
+
+        def _iter():
+            max_stop = max((len(s) for s in stop_sequences), default=0)
+            pending: List[int] = []
+            for t in self._generate_stream(
+                prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
+            ):
+                pending.append(t)
+                if detect_stop_tokens(pending, stop_sequences):
+                    return
+                # hold back max_stop-1 tokens that could begin a stop sequence
+                while len(pending) > max(0, max_stop - 1):
+                    yield pending.pop(0)
+            yield from pending
+
+        return _iter()
 
     def _generate_stream(self, prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences):
         lens = len(prompt)
@@ -498,7 +566,9 @@ class Generator:
         batch = np.zeros((1, Tb), np.int32)
         batch[0, :lens] = np.asarray(prompt, np.int32)
         cache_len = _run_cache_len(self.max_seq_length, total_max, Tb)
-        kv = transformer.init_kv_cache(self.cfg, 1, cache_len, dtype=self.cache_dtype)
+        kv = self._place_kv(
+            transformer.init_kv_cache(self.cfg, 1, cache_len, dtype=self.cache_dtype)
+        )
         last_logits, kv = self._prefill_fn(1, Tb)(
             self.params, jnp.asarray(batch), kv, jnp.asarray([lens], jnp.int32)
         )

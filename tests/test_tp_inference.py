@@ -1,0 +1,112 @@
+"""Tensor-parallel (GSPMD) inference: Megatron-sharded weights + KV heads
+split across chips must reproduce single-device generation token-for-token.
+
+Beyond reference parity: the reference has no tensor parallelism at all
+(SURVEY.md §2.4 "Tensor parallelism: Absent"); on TPU it is a declarative
+layout over a mesh (parallel/sharding.py) with XLA inserting the
+all-gather/psum collectives over ICI.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models.transformer import init_params
+from mdi_llm_tpu.parallel.mesh import make_mesh
+from tests.test_model import CONFIG_VARIANTS, tiny_config
+
+PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7], [2, 7, 1]]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(block_size=128, n_layer=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def single(model):
+    cfg, params = model
+    return Generator(cfg, params, cache_dtype=jnp.float32)
+
+
+def test_tp_matches_single_device(model, single, devices):
+    cfg, params = model
+    want, _ = single.generate(PROMPTS, 12, temperature=0.0)
+    eng = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"tp": 2}, devices[:2]),
+    )
+    got, _ = eng.generate(PROMPTS, 12, temperature=0.0)
+    assert got == want
+
+
+def test_dp_tp_matches_single_device(model, single, devices):
+    cfg, params = model
+    want, _ = single.generate(PROMPTS, 10, temperature=0.0)
+    eng = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"dp": 2, "tp": 2}, devices[:4]),
+    )
+    got, _ = eng.generate(PROMPTS, 10, temperature=0.0)
+    assert got == want
+
+
+def test_tp_gqa_with_stop_sequences(single, devices):
+    """GQA KV-group sharding (G=2 over tp=2) + host-side stop detection."""
+    cfg = tiny_config(block_size=128, n_layer=3, **CONFIG_VARIANTS["gqa"])
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    ref = Generator(cfg, params, cache_dtype=jnp.float32)
+    free, _ = ref.generate(PROMPTS[:2], 10, temperature=0.0)
+    stop = [free[0][len(PROMPTS[0]) + 2]]
+    want, _ = ref.generate(PROMPTS[:2], 10, temperature=0.0, stop_sequences=[stop])
+    eng = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"tp": 2}, devices[:2]),
+    )
+    got, _ = eng.generate(PROMPTS[:2], 10, temperature=0.0, stop_sequences=[stop])
+    assert got == want
+
+
+def test_tp_rejects_indivisible_heads(devices):
+    cfg = tiny_config(n_head=3, n_query_groups=3, n_embd=48)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    with pytest.raises(ValueError, match="n_head"):
+        Generator(
+            cfg, params, cache_dtype=jnp.float32,
+            mesh=make_mesh({"tp": 2}, devices[:2]),
+        )
+
+
+def test_tp_rejects_quantized(model, devices):
+    cfg, params = model
+    with pytest.raises(ValueError, match="quantized"):
+        Generator(
+            cfg, params, quantize="int8",
+            mesh=make_mesh({"tp": 2}, devices[:2]),
+        )
+
+
+def test_dp_rejects_ragged_batch(model, devices):
+    cfg, params = model
+    eng = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"dp": 2}, devices[:2]),
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        eng.generate(PROMPTS[:3], 4, temperature=0.0)
+
+
+def test_cli_tp_flag_exclusions():
+    from mdi_llm_tpu.cli.sample import main
+
+    with pytest.raises(SystemExit, match="exclusive"):
+        main(
+            [
+                "--model", "pythia-14m", "--tp-devices", "2",
+                "--pipeline-stages", "2", "--n-samples", "1", "--n-tokens", "4",
+            ]
+        )
