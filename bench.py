@@ -42,7 +42,7 @@ def main():
         help="pipeline mode: samples batched per ring slot (M)",
     )
     ap.add_argument("--dtype", choices=("bfloat16", "float16", "float32"), default="bfloat16")
-    ap.add_argument("--quantize", choices=("none", "int8", "w8a8"), default="none")
+    ap.add_argument("--quantize", choices=("none", "int8", "w8a8", "int4"), default="none")
     ap.add_argument("--kv-dtype", choices=("auto", "bfloat16", "float16", "float32", "float8"), default="auto")
     # decode default 256 measured 2283 tok/s/chip vs 2133 at 128 (v5e, r3):
     # longer scans amortize the host sync between dispatches.  Pipeline mode
@@ -76,10 +76,10 @@ def main():
     if args.quantize != "none":
         # build the int8 tree directly: an 8B-class model never exists in
         # f32/bf16, so Llama-3-8B fits one v5e chip for quantized benches
-        from mdi_llm_tpu.ops.quant import init_quantized_params
+        from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, init_quantized_params
 
         params = init_quantized_params(
-            cfg, mode="w8" if args.quantize == "int8" else "w8a8", dtype=dtype
+            cfg, mode=FLAG_TO_MODE[args.quantize], dtype=dtype
         )
         if not args.pipeline:
             # single-chip engine keeps the tree as-is: pin it on device once

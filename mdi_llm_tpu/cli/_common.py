@@ -49,11 +49,12 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--device", default=None, help="jax platform override (tpu/cpu)")
     ap.add_argument(
         "--quantize",
-        choices=("none", "int8", "w8a8"),
+        choices=("none", "int8", "w8a8", "int4"),
         default="none",
         help="int8: weight-only (halves weight HBM traffic, near-exact); "
         "w8a8: also dynamically quantizes activations for full int8 MXU "
-        "matmuls (faster, coarser numerics)",
+        "matmuls (faster, coarser numerics); int4: group-wise weight-only "
+        "nibble packing (quarters weight traffic, coarser numerics)",
     )
     ap.add_argument(
         "--kv-dtype",

@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
 from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.utils.context_managers import catch_loop_errors
+from mdi_llm_tpu.ops.quant import FLAG_TO_MODE
 from mdi_llm_tpu.ops.sampling import sample
 
 
@@ -156,36 +157,38 @@ class Generator:
         self.mesh = mesh
         self._kv_sharding = None
         self._dp = 1
+        if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         if mesh is not None and quantize not in (None, "none"):
             raise ValueError(
                 "quantized trees use custom leaf names the GSPMD sharding "
                 "rules don't cover; drop mesh or quantize"
             )
-        if quantize in ("int8", "w8a8"):
+        if quantize in FLAG_TO_MODE:
             from mdi_llm_tpu.ops.quant import quantize_params
 
             # quantization happens host-side (numpy); pin the tree on device
             # or every jit call re-uploads the whole model
-            mode = "w8" if quantize == "int8" else "w8a8"
-            params = jax.device_put(quantize_params(params, mode=mode))
-        elif quantize not in (None, "none"):
-            raise ValueError(f"unknown quantize mode {quantize!r}")
+            params = jax.device_put(
+                quantize_params(params, mode=FLAG_TO_MODE[quantize])
+            )
         if mesh is not None:
             from mdi_llm_tpu.parallel.sharding import shard_params
 
             tp_n = int(mesh.shape.get("tp", 1))
             dp_n = int(mesh.shape.get("dp", 1))
             if tp_n > 1:
-                bad = [
-                    name
-                    for name, dim in (
-                        ("n_head", cfg.n_head),
-                        ("n_query_groups", cfg.n_query_groups),
-                        ("padded_vocab_size", cfg.padded_vocab_size),
-                        ("intermediate_size", cfg.intermediate_size),
-                    )
-                    if dim % tp_n
+                moe = cfg.mlp_class_name == "LLaMAMoE"
+                dims = [
+                    ("n_head", cfg.n_head),
+                    ("n_query_groups", cfg.n_query_groups),
+                    ("padded_vocab_size", cfg.padded_vocab_size),
+                    # sharding.py shards the expert axis for MoE MLPs and the
+                    # intermediate axis for dense ones — validate accordingly
+                    ("n_expert", cfg.n_expert) if moe
+                    else ("intermediate_size", cfg.intermediate_size),
                 ]
+                bad = [name for name, dim in dims if dim % tp_n]
                 if bad:
                     raise ValueError(
                         f"tp={tp_n} does not divide {', '.join(bad)} of "

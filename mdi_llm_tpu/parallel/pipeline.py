@@ -86,12 +86,10 @@ class PipelineEngine:
         samples_per_slot: int = 1,  # M: samples traveling together per ring slot
         rotations_per_call: int = 16,  # steady-state ring rotations per jit call
     ):
-        if quantize in ("int8", "w8a8"):
-            from mdi_llm_tpu.ops.quant import quantize_params
+        if quantize in ("int8", "w8a8", "int4"):
+            from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, quantize_params
 
-            params = quantize_params(
-                params, mode="w8" if quantize == "int8" else "w8a8"
-            )
+            params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
         elif quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         if cache_dtype is None:

@@ -220,3 +220,80 @@ def test_resolve_kv_dtype():
     assert resolve_kv_dtype("auto") is None
     assert resolve_kv_dtype("float8") == jnp.float8_e4m3fn
     assert resolve_kv_dtype("bfloat16") == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# int4 (packed-nibble, group-wise scales)
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_tensor4_roundtrip_error():
+    from mdi_llm_tpu.ops.quant import quantize_tensor4, unpack_w4
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(3, 16, 256)).astype(np.float32)  # stacked layout
+    packed, scale = quantize_tensor4(w)
+    assert packed.dtype == np.int8 and packed.shape == (3, 16, 128)
+    assert scale.shape == (3, 16, 2)  # 256 / group 128
+    wd = np.asarray(unpack_w4(jnp.asarray(packed), jnp.asarray(scale), jnp.float32))
+    # symmetric int4: |err| <= scale/2 per element, per group
+    err = np.abs(wd - w).reshape(3, 16, 2, 128).max(-1)
+    assert np.all(err <= scale / 2 + 1e-6)
+
+    # zero weights stay exactly zero
+    p0, s0 = quantize_tensor4(np.zeros((4, 8), np.float32))
+    assert np.all(
+        np.asarray(unpack_w4(jnp.asarray(p0), jnp.asarray(s0), jnp.float32)) == 0
+    )
+
+
+def test_quantized_einsum_w4_matches_dequantized():
+    from mdi_llm_tpu.ops.quant import quantize_tensor4, unpack_w4
+
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(24, 64)).astype(np.float32)
+    x = jnp.asarray(rng.normal(size=(5, 64)).astype(np.float32))
+    packed, scale = quantize_tensor4(w)
+    p = {"weight_q4": jnp.asarray(packed), "scale": jnp.asarray(scale)}
+    got = quantized_einsum("...i,oi->...o", x, p)
+    want = jnp.einsum(
+        "...i,oi->...o", x, unpack_w4(jnp.asarray(packed), jnp.asarray(scale), jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_int4_generation_runs_and_tracks_f32():
+    cfg = tiny_cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    ref = Generator(cfg, params, cache_dtype=jnp.float32)
+    eng = Generator(cfg, params, cache_dtype=jnp.float32, quantize="int4")
+    prompts = [[5, 9, 2], [7, 1, 3]]
+    want, _ = ref.generate(prompts, 8, temperature=0.0)
+    got, stats = eng.generate(prompts, 8, temperature=0.0)
+    assert all(len(o) == 11 for o in got)
+    assert stats.tokens_generated == 16
+    # int4 rounding shifts logits; outputs need not match token-for-token,
+    # but the first generated token comes from near-identical prompt logits
+    # on this tiny model
+    assert got[0][3] == want[0][3]
+
+
+def test_int4_pipeline_runs(devices):
+    from mdi_llm_tpu.parallel.pipeline import PipelineEngine
+
+    cfg = tiny_cfg(n_layer=4)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(6), dtype=jnp.float32)
+    eng = PipelineEngine(cfg, params, n_stages=2, quantize="int4", devices=devices[:2])
+    outs, stats = eng.generate([[5, 9, 2], [7, 1, 3]], 6, temperature=0.0)
+    assert all(len(o) == 9 for o in outs)
+    assert stats.tokens_generated == 12
+
+
+def test_init_quantized_params_w4_generates():
+    from mdi_llm_tpu.ops.quant import init_quantized_params
+
+    cfg = tiny_cfg()
+    params = init_quantized_params(cfg, mode="w4", dtype=jnp.float32)
+    eng = Generator(cfg, jax.device_put(params), cache_dtype=jnp.float32)
+    outs, _ = eng.generate([[3, 1, 4]], 5, temperature=0.0)
+    assert len(outs[0]) == 8
