@@ -63,7 +63,8 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=0,
         help="tensor-parallel inference over N devices (GSPMD Megatron "
-        "sharding; weights and KV heads split across chips)",
+        "sharding; weights and KV heads split across chips); combines with "
+        "--pipeline-stages S into an S x N pipe-by-tp mesh",
     )
     # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
@@ -112,12 +113,12 @@ def main(argv=None):
                 "--speculative requires --greedy (or --temperature 0) and "
                 "--n-samples 1"
             )
-    if args.tp_devices and (args.pipeline_stages or args.sp_devices):
-        raise SystemExit(
-            "--tp-devices is exclusive with --pipeline-stages and --sp-devices"
-        )
+    if args.tp_devices and args.sp_devices:
+        raise SystemExit("--tp-devices is exclusive with --sp-devices")
     if args.tp_devices < 0:
         raise SystemExit("--tp-devices must be a positive device count")
+    if args.tp_devices > 1 and args.pipeline_stages and args.quantize not in (None, "none"):
+        raise SystemExit("--quantize is not supported on a pipe x tp mesh yet")
     seq_len = args.sequence_length
 
     from mdi_llm_tpu.utils.profiling import profile
@@ -154,8 +155,9 @@ def main(argv=None):
                 cache_dtype=resolve_kv_dtype(args.kv_dtype),
                 samples_per_slot=args.samples_per_slot,
                 rotations_per_call=args.chunk,
+                tp=max(1, args.tp_devices),
             )
-            n_nodes = args.pipeline_stages
+            n_nodes = args.pipeline_stages * max(1, args.tp_devices)
             outs, stats = engine.generate(
                 prompt_ids, args.n_tokens, temperature=temperature,
                 top_k=args.top_k, top_p=args.top_p, stop_sequences=stop_seqs,

@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=16,
         help="steady-state ring rotations per jit dispatch",
     )
+    ap.add_argument(
+        "--tp-devices",
+        type=int,
+        default=1,
+        help="tensor-parallel devices per pipeline stage (pipe x tp mesh)",
+    )
     return ap
 
 
@@ -124,10 +130,11 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
             n_stages=(
                 args.pipeline_stages
                 or nodes_cfg.pipeline_stages
-                or jax.device_count()
+                or jax.device_count() // max(1, args.tp_devices)
             ),
             samples_per_slot=args.samples_per_slot,
             rotations_per_call=args.chunk,
+            tp=max(1, args.tp_devices),
         )
         spec = broadcast_run_spec(spec)
     else:
@@ -151,6 +158,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         cache_dtype=resolve_kv_dtype(spec["kv_dtype"]),
         samples_per_slot=spec.get("samples_per_slot", 1),
         rotations_per_call=spec.get("rotations_per_call", 16),
+        tp=spec.get("tp", 1),
     )
     t0 = time.perf_counter()
     outs, stats = engine.generate(

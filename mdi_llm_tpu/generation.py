@@ -173,27 +173,15 @@ class Generator:
                 quantize_params(params, mode=FLAG_TO_MODE[quantize])
             )
         if mesh is not None:
-            from mdi_llm_tpu.parallel.sharding import shard_params
+            from mdi_llm_tpu.parallel.sharding import (
+                shard_params,
+                validate_tp_divisibility,
+            )
 
             tp_n = int(mesh.shape.get("tp", 1))
             dp_n = int(mesh.shape.get("dp", 1))
-            if tp_n > 1:
-                moe = cfg.mlp_class_name == "LLaMAMoE"
-                dims = [
-                    ("n_head", cfg.n_head),
-                    ("n_query_groups", cfg.n_query_groups),
-                    ("padded_vocab_size", cfg.padded_vocab_size),
-                    # sharding.py shards the expert axis for MoE MLPs and the
-                    # intermediate axis for dense ones — validate accordingly
-                    ("n_expert", cfg.n_expert) if moe
-                    else ("intermediate_size", cfg.intermediate_size),
-                ]
-                bad = [name for name, dim in dims if dim % tp_n]
-                if bad:
-                    raise ValueError(
-                        f"tp={tp_n} does not divide {', '.join(bad)} of "
-                        f"{cfg.name}"
-                    )
+            # vocab counts here: the Generator tp-shards embeddings/head
+            validate_tp_divisibility(cfg, tp_n, check_vocab=True)
             params = shard_params(params, cfg, mesh, "tp" if tp_n > 1 else None)
             self._dp = dp_n
             # KV cache (L, B, G, S, hs): batch on dp, KV groups on tp

@@ -39,5 +39,12 @@ def make_mesh(
     return Mesh(grid, tuple(sizes.keys()))
 
 
-def pipeline_mesh(n_stages: int, devices: Optional[Sequence] = None) -> Mesh:
+def pipeline_mesh(
+    n_stages: int, devices: Optional[Sequence] = None, tp: int = 1
+) -> Mesh:
+    """1-D stage ring, optionally × a tensor-parallel axis within each stage
+    (the classic serving topology: tp inside a host's ICI domain, pipeline
+    across)."""
+    if tp > 1:
+        return make_mesh({"pipe": n_stages, "tp": tp}, devices)
     return make_mesh({"pipe": n_stages}, devices)

@@ -82,23 +82,35 @@ class PipelineEngine:
         cache_dtype=None,  # None → params dtype
         rng_seed: int = 1337,
         devices: Optional[Sequence] = None,
-        quantize: Optional[str] = None,  # None | "int8" (weight-only) | "w8a8"
+        quantize: Optional[str] = None,  # None | "int8" | "w8a8" | "int4"
         samples_per_slot: int = 1,  # M: samples traveling together per ring slot
         rotations_per_call: int = 16,  # steady-state ring rotations per jit call
+        tp: int = 1,  # tensor-parallel devices per stage (pipe x tp mesh)
     ):
-        if quantize in ("int8", "w8a8", "int4"):
-            from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, quantize_params
+        from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, quantize_params
+        from mdi_llm_tpu.parallel.sharding import validate_tp_divisibility
 
-            params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
-        elif quantize not in (None, "none"):
+        if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
             raise ValueError(f"unknown quantize mode {quantize!r}")
+        # derive the effective mesh/tp BEFORE quantizing: the quantize-vs-tp
+        # guard must see the mesh-derived tp, not just the tp argument
+        if mesh is None:
+            n_dev = len(devices or jax.devices())
+            mesh = pipeline_mesh(n_stages or n_dev // tp, devices, tp=tp)
+        self.mesh = mesh
+        S = int(mesh.shape["pipe"])
+        self.n_stages = S
+        self.tp = int(mesh.shape.get("tp", 1))
+        validate_tp_divisibility(cfg, self.tp)
+        if self.tp > 1 and quantize not in (None, "none"):
+            raise ValueError(
+                "quantized trees use custom leaf names the tp sharding rules "
+                "don't cover; drop tp or quantize"
+            )
+        if quantize in FLAG_TO_MODE:
+            params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
         if cache_dtype is None:
             cache_dtype = transformer.param_dtype(params)
-        if mesh is None:
-            mesh = pipeline_mesh(n_stages or len(devices or jax.devices()), devices)
-        self.mesh = mesh
-        S = int(mesh.devices.size)
-        self.n_stages = S
         self.cfg = cfg
         self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
         self.cache_dtype = cache_dtype
@@ -111,9 +123,24 @@ class PipelineEngine:
         pipe_sh = NamedSharding(mesh, P("pipe"))
         repl_sh = NamedSharding(mesh, P())
         blocks_np = _pad_stage_blocks(stages, self.l_max)
-        self.stage_blocks = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, pipe_sh), blocks_np
-        )
+        if self.tp > 1:
+            # stage axis manual over "pipe"; weight dims additionally laid
+            # out under the Megatron specs so GSPMD (tp is an auto axis of
+            # the ring shard_map) inserts the all-reduces within each stage
+            from mdi_llm_tpu.parallel.sharding import param_specs
+
+            bspecs = param_specs(cfg, "tp")["blocks"]
+            self.stage_blocks = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(
+                    a, NamedSharding(mesh, P("pipe", *s))
+                ),
+                blocks_np,
+                bspecs,
+            )
+        else:
+            self.stage_blocks = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, pipe_sh), blocks_np
+            )
         # embedding / final norm / head replicated on every stage (vocab
         # sharding over the pipe axis is the planned optimization)
         head_params = {
@@ -161,7 +188,10 @@ class PipelineEngine:
             seq_len or self.max_seq_length,
             self.cfg.head_size,
         )
-        sh = NamedSharding(self.mesh, P("pipe"))
+        sh = NamedSharding(
+            self.mesh,
+            P("pipe", None, None, None, "tp" if self.tp > 1 else None),
+        )
         return {
             "k": jax.device_put(jnp.zeros(shape, self.cache_dtype), sh),
             "v": jax.device_put(jnp.zeros(shape, self.cache_dtype), sh),
@@ -320,7 +350,11 @@ class PipelineEngine:
                 {"k": pipe, "v": pipe},
                 (emit_spec, emit_spec, emit_spec),
             ),
-            check_vma=not self.multiprocess,
+            # manual over the stage ring only; a "tp" mesh axis (if any)
+            # stays automatic so GSPMD lays the per-stage matmuls out under
+            # the Megatron weight shardings
+            axis_names={"pipe"},
+            check_vma=not self.multiprocess and self.tp == 1,
         )
         return jax.jit(sm, donate_argnums=(3, 4))
 
@@ -414,7 +448,8 @@ class PipelineEngine:
                 {"x": pipe, "sid": pipe, "pos": pipe, "valid": pipe},
                 (emit_spec, emit_spec, emit_spec),
             ),
-            check_vma=not self.multiprocess,
+            axis_names={"pipe"},
+            check_vma=not self.multiprocess and self.tp == 1,
         )
         return jax.jit(sm, donate_argnums=(3, 4))
 

@@ -97,6 +97,28 @@ def param_specs(
     return specs
 
 
+def validate_tp_divisibility(cfg: Config, tp: int, check_vocab: bool = False):
+    """Reject configs whose sharded dimensions don't divide by tp.  The rule
+    table mirrors param_specs: attention heads/KV groups always shard; MoE
+    configs shard the expert axis while dense ones shard the intermediate
+    dim; the vocab axis only matters where embeddings/head are tp-sharded
+    (Generator — the pipeline ring keeps head params replicated)."""
+    if tp <= 1:
+        return
+    moe = cfg.mlp_class_name == "LLaMAMoE"
+    dims = [
+        ("n_head", cfg.n_head),
+        ("n_query_groups", cfg.n_query_groups),
+        ("n_expert", cfg.n_expert) if moe
+        else ("intermediate_size", cfg.intermediate_size),
+    ]
+    if check_vocab:
+        dims.append(("padded_vocab_size", cfg.padded_vocab_size))
+    bad = [name for name, dim in dims if dim % tp]
+    if bad:
+        raise ValueError(f"tp={tp} does not divide {', '.join(bad)} of {cfg.name}")
+
+
 def shard_params(params: Any, cfg: Config, mesh: Mesh, tp_axis: Optional[str] = "tp"):
     """Place a params pytree onto `mesh` under the TP rules."""
     tp = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None

@@ -103,10 +103,12 @@ def test_dp_rejects_ragged_batch(model, devices):
 def test_cli_tp_flag_exclusions():
     from mdi_llm_tpu.cli.sample import main
 
+    # pipeline-stages x tp-devices is a supported combination (pipe x tp
+    # mesh); sequence parallelism is the remaining exclusion
     with pytest.raises(SystemExit, match="exclusive"):
         main(
             [
                 "--model", "pythia-14m", "--tp-devices", "2",
-                "--pipeline-stages", "2", "--n-samples", "1", "--n-tokens", "4",
+                "--sp-devices", "2", "--n-samples", "1", "--n-tokens", "4",
             ]
         )
