@@ -36,6 +36,25 @@ def resolve_kv_dtype(name: str):
     return None if name == "auto" else KV_DTYPES[name]
 
 
+def make_tp_mesh(tp_devices: int, quantize: str):
+    """Shared --tp-devices handling for the Generator entry points (sample,
+    chat): validate, then build a 1-D tp mesh over the first N devices."""
+    if tp_devices < 1:
+        raise SystemExit("--tp-devices must be a positive device count")
+    if quantize not in (None, "none"):
+        raise SystemExit("--quantize is not supported with --tp-devices yet")
+    import jax
+
+    from mdi_llm_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < tp_devices:
+        raise SystemExit(
+            f"--tp-devices {tp_devices} exceeds the {len(jax.devices())} "
+            "available devices"
+        )
+    return make_mesh({"tp": tp_devices}, jax.devices()[:tp_devices])
+
+
 def add_common_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--ckpt", type=Path, default=None, help="checkpoint directory")
     ap.add_argument(
@@ -51,10 +70,12 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
         "--quantize",
         choices=("none", "int8", "w8a8", "int4"),
         default="none",
-        help="int8: weight-only (halves weight HBM traffic, near-exact); "
-        "w8a8: also dynamically quantizes activations for full int8 MXU "
-        "matmuls (faster, coarser numerics); int4: group-wise weight-only "
-        "nibble packing (quarters weight traffic, coarser numerics)",
+        help="int8: weight-only (halves weight HBM traffic; fastest decode "
+        "measured); w8a8: also dynamically quantizes activations for full "
+        "int8 MXU matmuls (wins on compute-bound prefill/large tiles, the "
+        "per-token requantize makes it SLOWER than int8 for decode); int4: "
+        "group-wise weight-only nibble packing (quarters weight footprint, "
+        "coarser numerics)",
     )
     ap.add_argument(
         "--kv-dtype",

@@ -31,6 +31,13 @@ def build_parser():
     ap.add_argument("--temperature", type=float, default=TEMPERATURE)
     ap.add_argument("--top-k", type=int, default=TOP_K)
     ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument(
+        "--tp-devices",
+        type=int,
+        default=0,
+        help="tensor-parallel streaming over N devices (GSPMD Megatron "
+        "sharding; cuts per-token latency for models too big for one chip)",
+    )
     return ap
 
 
@@ -42,9 +49,15 @@ def main(argv=None):
     if tokenizer is None:
         raise SystemExit("chat needs a checkpoint with a tokenizer (--ckpt)")
     stop_seqs = prompt_style.stop_tokens(tokenizer)
+    mesh = None
+    if args.tp_devices:
+        from mdi_llm_tpu.cli._common import make_tp_mesh
+
+        mesh = make_tp_mesh(args.tp_devices, args.quantize)
     gen = Generator(
         cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed,
         quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
+        mesh=mesh,
     )
 
     print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")

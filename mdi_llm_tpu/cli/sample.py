@@ -168,13 +168,9 @@ def main(argv=None):
             mesh = None
             n_nodes = 1
             if args.tp_devices:
-                if args.quantize not in (None, "none"):
-                    raise SystemExit("--quantize is not supported with --tp-devices yet")
-                from mdi_llm_tpu.parallel.mesh import make_mesh
+                from mdi_llm_tpu.cli._common import make_tp_mesh
 
-                mesh = make_mesh(
-                    {"tp": args.tp_devices}, jax.devices()[: args.tp_devices]
-                )
+                mesh = make_tp_mesh(args.tp_devices, args.quantize)
                 n_nodes = args.tp_devices
             engine = Generator(
                 cfg, params, max_seq_length=seq_len, rng_seed=args.seed,

@@ -96,6 +96,11 @@ class PipelineEngine:
         # guard must see the mesh-derived tp, not just the tp argument
         if mesh is None:
             n_dev = len(devices or jax.devices())
+            if tp < 1 or n_dev % tp:
+                raise ValueError(
+                    f"tp={tp} must be a positive divisor of the {n_dev} "
+                    "available devices"
+                )
             mesh = pipeline_mesh(n_stages or n_dev // tp, devices, tp=tp)
         self.mesh = mesh
         S = int(mesh.shape["pipe"])

@@ -199,6 +199,20 @@ def test_chat_cli_two_turns_then_eof(tiny_ckpt, monkeypatch, capsys):
     assert "Chatting with" in out
 
 
+def test_chat_cli_tp_mesh(tiny_ckpt, monkeypatch, capsys):
+    """Streaming chat over a tp=2 GSPMD mesh (tiny ckpt, CPU devices)."""
+    from mdi_llm_tpu.cli import chat
+
+    inputs = iter(["the quick brown", ""])
+    monkeypatch.setattr("builtins.input", lambda *_: next(inputs))
+    rc = chat.main(
+        ["--ckpt", str(tiny_ckpt), "--dtype", "float32", "--n-tokens", "4",
+         "--tp-devices", "2", "--temperature", "0.0"]
+    )
+    assert rc == 0
+    assert "Chatting with" in capsys.readouterr().out
+
+
 def test_starter_debug_writes_role_log(tiny_ckpt, tmp_path):
     import json as _json
     import logging
