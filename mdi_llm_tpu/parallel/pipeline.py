@@ -86,6 +86,11 @@ class PipelineEngine:
         samples_per_slot: int = 1,  # M: samples traveling together per ring slot
         rotations_per_call: int = 16,  # steady-state ring rotations per jit call
         tp: int = 1,  # tensor-parallel devices per stage (pipe x tp mesh)
+        overlap_chunks: bool = False,  # dispatch the next steady chunk
+        # before fetching the previous chunk's emissions, hiding transfer +
+        # host bookkeeping under device compute.  Off by default: on the
+        # remote-attached (axon-tunnel) backend the overlapped dispatch was
+        # observed to stall; enable on directly-attached TPUs
     ):
         from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, quantize_params
         from mdi_llm_tpu.parallel.sharding import validate_tp_divisibility
@@ -170,6 +175,7 @@ class PipelineEngine:
         # amortizing host dispatch — critical when the chip sits behind an
         # RPC tunnel, the same economics as Generator's chunk_size.
         self.rotations_per_call = max(1, int(rotations_per_call))
+        self.overlap_chunks = bool(overlap_chunks)
         self.n_slots = S + 1  # one cache slot per ring position + dummy
         # Multi-node jobs (cli/starter.py + cli/secondary.py): every process
         # must be able to read the emitted tokens, so the ring all-gathers
@@ -500,8 +506,10 @@ class PipelineEngine:
 
     def _stage0_emits(self, emits):
         """Host view of one call's emissions: stage 0's tokens (R, M),
-        slot ids (R,), valid flags (R, M)."""
-        toks, sids, vals = (np.asarray(e) for e in emits)
+        slot ids (R,), valid flags (R, M).  One batched device_get — on a
+        remote-attached chip each separate host transfer costs a full RTT
+        (~40 ms measured), while one fetch of all three arrays is free."""
+        toks, sids, vals = jax.device_get(emits)
         return toks[:, : self.M], sids[:, 0], vals[:, : self.M]
 
     def _empty_overrides(self):
@@ -743,43 +751,105 @@ class PipelineEngine:
                 st[1] = idx + 1
             return ov, fed
 
+        def collect(emits, fed_map):
+            """Accept one call's emissions into `out` (tokens fed one
+            rotation before each emission row, per fed_map)."""
+            toks_e, sids_e, vals_e = self._stage0_emits(emits)
+            for t_row, s, v_row in zip(toks_e, sids_e, vals_e):
+                s = int(s)
+                for m in range(M):
+                    j = fed_map.get((s, m))
+                    if j is None or not v_row[m] or done[j]:
+                        continue
+                    out[j].append(int(t_row[m]))
+                    if (
+                        detect_stop_tokens(out[j][lens[j] :], stop_sequences)
+                        or budget(j) <= 0
+                    ):
+                        done[j] = True
+                        active.pop((s, m), None)
+            if fed_map:
+                stats.tok_time.append(
+                    (
+                        sum(len(o) - l for o, l in zip(out, lens)),
+                        time.perf_counter() - t_all,
+                    )
+                )
+            # a lane whose last prompt token was just fed switches to
+            # generating (auto-feed inside the jit)
+            for lane in list(filling):
+                j, idx = filling[lane]
+                if idx >= lens[j]:
+                    del filling[lane]
+                    active[lane] = j
+
+        # Double buffering: in the steady state the next chunk is dispatched
+        # BEFORE the previous chunk's emissions are fetched, so the
+        # device-to-host transfer and the host bookkeeping hide under the
+        # next chunk's compute (on a remote-attached chip the serialized
+        # fetch alone costs a large fraction of the chunk).  `pending` holds
+        # the in-flight chunk's (emits, fed_map); refill/reseed boundaries
+        # flush it first so scheduling always sees accepted tokens.
+        pending = None
+
+        def flush_pending():
+            nonlocal pending
+            if pending is not None:
+                em, fm = pending
+                pending = None
+                collect(em, fm)
+
         need_reseed = True  # initial seeding uses the same re-seed path
         # hard bound on rotations (scheduler-bug backstop: every sample costs
         # at most lens + max_new_tokens rotations, plus seeding and drain,
-        # plus up to one chunk of overshoot per sample finishing mid-chunk)
+        # plus chunk-overshoot slack: one chunk per mid-chunk finish and one
+        # in-flight chunk of lookahead)
         max_rot = (
             2 + 2 * S + N + sum(l + max_new_tokens for l in lens)
-            + N * self.rotations_per_call
+            + (N + 2) * self.rotations_per_call
         )
         # Ctrl-C mid-ring returns partial results (single-process; in a
         # multi-process job an interrupt tears down the whole SPMD group)
         with catch_loop_errors() as guard:
-            while active or filling or queue:
+            while active or filling or queue or pending:
                 if stats.rotations >= max_rot:
                     raise RuntimeError(
                         f"pipeline scheduler exceeded {max_rot} rotations with "
                         f"{len(active)} active / {len(filling)} filling / "
                         f"{len(queue)} queued samples"
                     )
+                if queue:
+                    # refill decisions need current lane state, and a refill
+                    # prefill would block on the in-flight chunk inside its
+                    # own timer anyway — flush first (no overlap lost: the
+                    # device serializes the prefill behind the chunk)
+                    flush_pending()
                 if batch_refills():
                     need_reseed = True
                 schedule_token_refills()
                 if not (active or filling):
-                    continue  # everything finished during prefill; the while
-                    # condition re-checks the queue (refills strictly drain it)
+                    flush_pending()
+                    continue  # everything finished; the while condition
+                    # re-checks the queue (refills strictly drain it)
                 n_rot = 1
-                if need_reseed:
-                    fed_prev = {}
-                    payload = self._init_payload(1, dtype)
-                    ov_dev, fed_cur = build_reseed_ov()
-                    need_reseed = False
-                elif filling:
-                    fed_prev = fed_cur
-                    ov, fed_cur = build_step_ov()
-                    ov_dev = (
-                        ov if ov is empty_dev
-                        else {k: jnp.asarray(v) for k, v in ov.items()}
-                    )
+                steady = not (need_reseed or filling)
+                if not steady:
+                    # boundary iteration: overrides are built from accepted
+                    # state, so the in-flight chunk (whose tokens are valid
+                    # continuations) must land first
+                    flush_pending()
+                    if need_reseed:
+                        fed_prev = {}
+                        payload = self._init_payload(1, dtype)
+                        ov_dev, fed_cur = build_reseed_ov()
+                        need_reseed = False
+                    else:
+                        fed_prev = fed_cur
+                        ov, fed_cur = build_step_ov()
+                        ov_dev = (
+                            ov if ov is empty_dev
+                            else {k: jnp.asarray(v) for k, v in ov.items()}
+                        )
                 else:
                     # steady state (no refills pending): every surviving lane
                     # auto-feeds its own sampled token inside the jit, so R
@@ -787,57 +857,29 @@ class PipelineEngine:
                     # The lane->sample map is constant across the chunk; a
                     # sample finishing mid-chunk just has its surplus tokens
                     # discarded (same tradeoff as Generator chunk_size).
-                    # Bounded by the largest remaining budget (no lane can
-                    # accept more), floored to a power of two so the set of
-                    # compiled scan lengths stays small.
+                    # Bounded by the largest remaining budget (stale by at
+                    # most the in-flight chunk — surplus writes clamp into
+                    # finished lanes' own cache slots), floored to a power of
+                    # two so the set of compiled scan lengths stays small.
                     maxbud = max(budget(j) for j in active.values())
                     n_rot = max(1, min(self.rotations_per_call, maxbud))
                     n_rot = 1 << (n_rot.bit_length() - 1)
                     fed_prev = {**fed_cur, **dict(active)}
                     fed_cur = fed_prev
                     ov_dev = self._empty_chunk_dev(n_rot)
+                    if not self.overlap_chunks:
+                        flush_pending()
                 self.key, sub = jax.random.split(self.key)
                 kv, payload, emits = decode(
-                    self.stage_blocks,
-                    self.head_params,
-                    self.rope,
-                    kv,
-                    payload,
-                    ov_dev,
-                    sub,
+                    self.stage_blocks, self.head_params, self.rope,
+                    kv, payload, ov_dev, sub,
                 )
                 stats.rotations += n_rot
-
-                # collect tokens fed one rotation ago
-                toks_e, sids_e, vals_e = self._stage0_emits(emits)
-                for t_row, s, v_row in zip(toks_e, sids_e, vals_e):
-                    s = int(s)
-                    for m in range(M):
-                        j = fed_prev.get((s, m))
-                        if j is None or not v_row[m] or done[j]:
-                            continue
-                        out[j].append(int(t_row[m]))
-                        if (
-                            detect_stop_tokens(out[j][lens[j] :], stop_sequences)
-                            or budget(j) <= 0
-                        ):
-                            done[j] = True
-                            active.pop((s, m), None)
-                if fed_prev:
-                    stats.tok_time.append(
-                        (
-                            sum(len(o) - l for o, l in zip(out, lens)),
-                            time.perf_counter() - t_all,
-                        )
-                    )
-
-                # a lane whose last prompt token was just fed switches to
-                # generating (auto-feed inside the jit)
-                for lane in list(filling):
-                    j, idx = filling[lane]
-                    if idx >= lens[j]:
-                        del filling[lane]
-                        active[lane] = j
+                if steady and self.overlap_chunks:
+                    flush_pending()  # previous chunk, hidden under this one
+                    pending = (emits, fed_prev)
+                else:
+                    collect(emits, fed_prev)
 
         stats.interrupted = stats.interrupted or guard.interrupted
         trimmed = []
