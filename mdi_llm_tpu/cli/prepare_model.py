@@ -33,6 +33,14 @@ def build_parser():
     ap.add_argument("--n-stages", "--n-nodes", type=int, default=0, dest="n_stages")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--access-token", default=None)
+    ap.add_argument(
+        "--quantize",
+        choices=("none", "int8", "int4"),
+        default="none",
+        help="additionally write a pre-quantized checkpoint "
+        "(<ckpt>-<mode>/) that engines load with no further flags — "
+        "quantize once at prepare time instead of per process at load",
+    )
     return ap
 
 
@@ -52,14 +60,40 @@ def main(argv=None):
             args.model, args.checkpoints_dir, access_token=args.access_token, dtype=dtype
         )
 
-    if args.n_stages > 1:
+    cfg = params = None
+    if args.n_stages > 1 or args.quantize != "none":
         cfg, params = load_checkpoint(ckpt_dir)
-        stages = split_params(cfg, params, args.n_stages)
-        chunk_dir = ckpt_dir / "chunks" / f"{args.n_stages}stages"
+
+    def write_stages(base_dir, cfg_, params_):
+        stages = split_params(cfg_, params_, args.n_stages)
+        chunk_dir = base_dir / "chunks" / f"{args.n_stages}stages"
         for i, st in enumerate(stages):
-            save_checkpoint(st, cfg, chunk_dir / f"stage_{i}")
-        save_stage_manifest(chunk_dir, cfg, args.n_stages)
+            save_checkpoint(st, cfg_, chunk_dir / f"stage_{i}")
+        save_stage_manifest(chunk_dir, cfg_, args.n_stages)
         print(f"wrote {args.n_stages} stage checkpoints → {chunk_dir}")
+
+    if args.n_stages > 1:
+        write_stages(ckpt_dir, cfg, params)
+
+    if args.quantize != "none":
+        import shutil
+
+        from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, quantize_params
+        from mdi_llm_tpu.utils.checkpoint import TOKENIZER_FILES
+
+        qp = quantize_params(params, mode=FLAG_TO_MODE[args.quantize])
+        q_dir = ckpt_dir.parent / f"{ckpt_dir.name}-{args.quantize}"
+        save_checkpoint(qp, cfg, q_dir)
+        # tokenizer files travel with the quantized copy so it is a
+        # self-contained --ckpt target
+        for name in TOKENIZER_FILES:
+            src = ckpt_dir / name
+            if src.exists():
+                shutil.copy(src, q_dir / name)
+        if args.n_stages > 1:
+            # pipeline deployments get pre-quantized stage chunks too
+            write_stages(q_dir, cfg, qp)
+        print(f"wrote {args.quantize}-quantized checkpoint → {q_dir}")
     print(f"checkpoint ready: {ckpt_dir}")
     return ckpt_dir
 

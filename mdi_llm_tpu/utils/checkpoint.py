@@ -708,7 +708,18 @@ def load_checkpoint(
     with ocp.PyTreeCheckpointer() as ckptr:
         params = ckptr.restore(ckpt_dir / "params")
     if dtype is not None:
-        params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, dtype=dtype), params)
+        # quantization-aware cast: integer leaves (int8 weights / packed int4
+        # nibbles) must never be floated — the quantized einsums dispatch on
+        # them — and f32 "scale" vectors keep their precision
+        def _cast(path, a):
+            a = jnp.asarray(a)
+            if not jnp.issubdtype(a.dtype, jnp.floating):
+                return a
+            if getattr(path[-1], "key", None) == "scale":
+                return a
+            return a.astype(dtype)
+
+        params = jax.tree_util.tree_map_with_path(_cast, params)
     return cfg, params
 
 

@@ -219,3 +219,43 @@ def test_evaluate_cli(saved_ckpt, tmp_path, capsys):
     # random tokens vs random-ish weights: loss near ln(96)
     assert 2.0 < rec["loss"] < 8.0
     assert rec["perplexity"] > 1.0 and rec["split"] == "val"
+
+
+def test_prepare_model_quantized_checkpoint(saved_ckpt):
+    """--quantize writes a pre-quantized sibling checkpoint that loads and
+    generates with no further flags (quantize once at prepare time).  The
+    dtype-casting load path must preserve the integer weights and the f32
+    scales (a blanket cast silently de-quantizes int8 and crashes int4)."""
+    from mdi_llm_tpu.cli.prepare_model import main as prep_main
+    from mdi_llm_tpu.generation import Generator
+
+    prep_main([str(saved_ckpt), "--quantize", "int8", "--n-stages", "2"])
+    q_dir = saved_ckpt.parent / f"{saved_ckpt.name}-int8"
+    # the engine-CLI load path casts to a compute dtype
+    cfg, qp = load_checkpoint(q_dir, dtype=jnp.float32)
+    leaf = qp["blocks"]["attn"]["qkv"]
+    assert leaf["weight_q"].dtype == jnp.int8
+    assert leaf["scale"].dtype == jnp.float32
+    eng = Generator(cfg, jax.device_put(qp), cache_dtype=jnp.float32)
+    outs, _ = eng.generate([[5, 9, 2]], 4, temperature=0.0)
+    assert len(outs[0]) == 7
+    # pipeline deployments get pre-quantized stage chunks in the sibling
+    chunk = q_dir / "chunks" / "2stages"
+    assert (chunk / "stage_map.json").exists()
+    _, st0 = load_checkpoint(chunk / "stage_0", dtype=jnp.float32, cfg=cfg)
+    assert st0["blocks"]["attn"]["qkv"]["weight_q"].dtype == jnp.int8
+
+
+def test_prepare_model_int4_checkpoint_generates(saved_ckpt):
+    """int4 sibling survives the casting load path (packed nibbles stay
+    int8) and drives the Generator end to end."""
+    from mdi_llm_tpu.cli.prepare_model import main as prep_main
+    from mdi_llm_tpu.generation import Generator
+
+    prep_main([str(saved_ckpt), "--quantize", "int4"])
+    q_dir = saved_ckpt.parent / f"{saved_ckpt.name}-int4"
+    cfg, qp = load_checkpoint(q_dir, dtype=jnp.float32)
+    assert qp["blocks"]["attn"]["qkv"]["weight_q4"].dtype == jnp.int8
+    eng = Generator(cfg, jax.device_put(qp), cache_dtype=jnp.float32)
+    outs, _ = eng.generate([[7, 1, 3]], 4, temperature=0.0)
+    assert len(outs[0]) == 7
