@@ -38,9 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="topology JSON and this node's secondary index (0-based)",
     )
     # accepted for launch-script symmetry with cli/starter.py; the effective
-    # value always comes from the starter's broadcast run spec
+    # values always come from the starter's broadcast run spec
     ap.add_argument("--pipeline-stages", type=int, default=None)
     ap.add_argument("--samples-per-slot", type=int, default=1)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--tp-devices", type=int, default=1)
     return ap
 
 

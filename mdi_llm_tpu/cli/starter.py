@@ -114,6 +114,11 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
                 rng.integers(1, cfg.vocab_size, 8).tolist() for _ in raw_prompts
             ]
             stop_seqs = ()
+        # CLI beats config file, config beats the default of 1 (same
+        # precedence as the device override, gptserver.py:601-617)
+        eff_tp = (
+            args.tp_devices if args.tp_devices > 1 else nodes_cfg.tp_devices
+        )
         spec = dict(
             prompt_ids=prompt_ids,
             n_tokens=args.n_tokens,
@@ -130,11 +135,11 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
             n_stages=(
                 args.pipeline_stages
                 or nodes_cfg.pipeline_stages
-                or jax.device_count() // max(1, args.tp_devices)
+                or jax.device_count() // max(1, eff_tp)
             ),
             samples_per_slot=args.samples_per_slot,
             rotations_per_call=args.chunk,
-            tp=max(1, args.tp_devices),
+            tp=max(1, eff_tp),
         )
         spec = broadcast_run_spec(spec)
     else:

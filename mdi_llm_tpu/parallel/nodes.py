@@ -60,6 +60,7 @@ class NodesConfig:
     starter: NodeInfo
     secondary: List[NodeInfo] = field(default_factory=list)
     pipeline_stages: Optional[int] = None  # None → one stage per chip
+    tp_devices: int = 1  # tensor-parallel devices per stage (pipe x tp)
 
     @property
     def n_nodes(self) -> int:
@@ -105,6 +106,7 @@ def parse_nodes_config(path) -> NodesConfig:
         starter=starter,
         secondary=secondary,
         pipeline_stages=raw.get("pipeline_stages"),
+        tp_devices=int(raw.get("tp_devices", 1)),
     )
 
 

@@ -297,3 +297,25 @@ def test_init_quantized_params_w4_generates():
     eng = Generator(cfg, jax.device_put(params), cache_dtype=jnp.float32)
     outs, _ = eng.generate([[3, 1, 4]], 5, temperature=0.0)
     assert len(outs[0]) == 8
+
+
+def test_int8_pipeline_matches_int8_single(devices):
+    """Quantized ring == quantized single-device generation token-for-token
+    (same int8 weights, greedy sampling; f32 compute on CPU)."""
+    cfg = tiny_cfg(n_layer=4)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    prompts = [[5, 9, 2], [7, 1, 3], [2, 2, 8, 4]]
+    single = Generator(cfg, params, cache_dtype=jnp.float32, quantize="int8")
+    want = []
+    for p in prompts:
+        o, _ = single.generate([p], 8, temperature=0.0)
+        want.append(o[0])
+
+    from mdi_llm_tpu.parallel.pipeline import PipelineEngine
+
+    eng = PipelineEngine(
+        cfg, params, n_stages=2, quantize="int8", devices=devices[:2],
+        cache_dtype=jnp.float32,
+    )
+    got, _ = eng.generate(prompts, 8, temperature=0.0)
+    assert got == want

@@ -112,3 +112,16 @@ def test_cli_tp_flag_exclusions():
                 "--sp-devices", "2", "--n-samples", "1", "--n-tokens", "4",
             ]
         )
+
+
+def test_dp_streaming_rejected_at_call_time(model, devices):
+    """generate_chat must raise when constructed over a dp mesh BEFORE the
+    caller starts iterating (a raise inside the generator body would only
+    surface on the first next(), after streaming has begun)."""
+    cfg, params = model
+    eng = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"dp": 2}, devices[:2]),
+    )
+    with pytest.raises(ValueError, match="tp-only"):
+        eng.generate_chat([3, 1, 4], 4, temperature=0.0)
