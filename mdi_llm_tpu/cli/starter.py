@@ -81,7 +81,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--tp-devices",
         type=int,
-        default=1,
+        default=None,  # None → config file value → 1 (an explicit 1 must
+        # be able to override a config's tp_devices)
         help="tensor-parallel devices per pipeline stage (pipe x tp mesh)",
     )
     return ap
@@ -117,7 +118,8 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         # CLI beats config file, config beats the default of 1 (same
         # precedence as the device override, gptserver.py:601-617)
         eff_tp = (
-            args.tp_devices if args.tp_devices > 1 else nodes_cfg.tp_devices
+            args.tp_devices if args.tp_devices is not None
+            else nodes_cfg.tp_devices
         )
         spec = dict(
             prompt_ids=prompt_ids,

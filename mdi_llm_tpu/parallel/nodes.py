@@ -91,7 +91,13 @@ def parse_nodes_config(path) -> NodesConfig:
             _node_from_ref(s, default_port=8089 + i)
             for i, s in enumerate(nodes.get("secondary", []) or [])
         ]
-        return NodesConfig(starter=starter, secondary=secondary)
+        # parallelism keys are top-level in both schemas
+        return NodesConfig(
+            starter=starter,
+            secondary=secondary,
+            pipeline_stages=raw.get("pipeline_stages"),
+            tp_devices=int(raw.get("tp_devices", 1)),
+        )
     # TPU-native schema
     coord = raw.get("coordinator", "127.0.0.1:8476")
     addr, _, port = coord.rpartition(":")

@@ -294,3 +294,22 @@ def test_pipeline_overlap_modes_parity(model, single_engine, overlap, devices):
     want = _single(single_engine, pool, 12)
     got, _ = eng.generate(pool, 12, temperature=0.0)
     assert got == want
+
+
+def test_pipeline_moe_matches_single_device(single_engine, devices):
+    """Routed MoE blocks (LLaMAMoE) travel the ring correctly: top-k expert
+    routing inside each stage's scanned block stack, token-identical to
+    single-device generation."""
+    cfg = tiny_config(
+        block_size=64, n_layer=4, mlp_class_name="LLaMAMoE",
+        n_expert=4, n_expert_per_token=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    single = Generator(cfg, params, cache_dtype=jnp.float32)
+    prompts = [[4, 8, 15], [16, 23, 42]]
+    want = [single.generate([p], 6, temperature=0.0)[0][0] for p in prompts]
+    eng = PipelineEngine(
+        cfg, params, mesh=pipeline_mesh(2, devices[:2]), cache_dtype=jnp.float32
+    )
+    got, _ = eng.generate(prompts, 6, temperature=0.0)
+    assert got == want

@@ -125,3 +125,21 @@ def test_dp_streaming_rejected_at_call_time(model, devices):
     )
     with pytest.raises(ValueError, match="tp-only"):
         eng.generate_chat([3, 1, 4], 4, temperature=0.0)
+
+
+def test_tp_moe_experts_sharded(devices):
+    """MoE inference over tp: the expert axis is the sharded dimension
+    (sharding.py P(None, e, ...)), token-identical to single device."""
+    cfg = tiny_config(
+        block_size=64, n_layer=3, mlp_class_name="LLaMAMoE",
+        n_expert=4, n_expert_per_token=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    single = Generator(cfg, params, cache_dtype=jnp.float32)
+    want, _ = single.generate(PROMPTS[:2], 8, temperature=0.0)
+    eng = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"tp": 2}, devices[:2]),
+    )
+    got, _ = eng.generate(PROMPTS[:2], 8, temperature=0.0)
+    assert got == want
