@@ -66,6 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
         "sharding; weights and KV heads split across chips); combines with "
         "--pipeline-stages S into an S x N pipe-by-tp mesh",
     )
+    ap.add_argument(
+        "--overlap-chunks",
+        action="store_true",
+        help="pipeline mode: dispatch the next decode chunk before fetching "
+        "the previous one (hides transfer + host work under compute on "
+        "directly-attached TPUs; known to stall on remote-tunnel backends)",
+    )
     # multi-host mesh bootstrap (≡ HTTP /init, model_dist.py:402-497)
     ap.add_argument("--coordinator", default=None, help="host:port of process 0")
     ap.add_argument("--process-id", type=int, default=None)
@@ -156,6 +163,7 @@ def main(argv=None):
                 samples_per_slot=args.samples_per_slot,
                 rotations_per_call=args.chunk,
                 tp=max(1, args.tp_devices),
+                overlap_chunks=args.overlap_chunks,
             )
             n_nodes = args.pipeline_stages * max(1, args.tp_devices)
             outs, stats = engine.generate(

@@ -42,7 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--pipeline-stages", type=int, default=None)
     ap.add_argument("--samples-per-slot", type=int, default=1)
     ap.add_argument("--chunk", type=int, default=16)
-    ap.add_argument("--tp-devices", type=int, default=1)
+    ap.add_argument("--tp-devices", type=int, default=None)
+    ap.add_argument("--overlap-chunks", action="store_true")
     return ap
 
 

@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
         # be able to override a config's tp_devices)
         help="tensor-parallel devices per pipeline stage (pipe x tp mesh)",
     )
+    ap.add_argument(
+        "--overlap-chunks",
+        action="store_true",
+        help="dispatch the next decode chunk before fetching the previous "
+        "one (directly-attached TPUs only; stalls on remote tunnels)",
+    )
     return ap
 
 
@@ -142,6 +148,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
             samples_per_slot=args.samples_per_slot,
             rotations_per_call=args.chunk,
             tp=max(1, eff_tp),
+            overlap_chunks=args.overlap_chunks,
         )
         spec = broadcast_run_spec(spec)
     else:
@@ -166,6 +173,7 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         samples_per_slot=spec.get("samples_per_slot", 1),
         rotations_per_call=spec.get("rotations_per_call", 16),
         tp=spec.get("tp", 1),
+        overlap_chunks=spec.get("overlap_chunks", False),
     )
     t0 = time.perf_counter()
     outs, stats = engine.generate(
