@@ -1,37 +1,75 @@
 """Benchmark harness: decode throughput on the available device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Scenario (mirrors BASELINE.md's TinyLlama configuration): TinyLlama-1.1B
-architecture, bf16, random weights (numerics identical to converted weights
-for throughput purposes), batched recurrent decode of 8 samples — the
-single-chip analog of the reference's "3-node recurrent pipeline,
-n-samples≥3" runs.  `vs_baseline` compares against ~7 tokens/s aggregate,
-the 3×Jetson-TX2 TinyLlama rate read off the reference's published
-tokens-vs-time plot (assets/time_vs_tokens_TinyLlama.png; no numeric tables
-exist — BASELINE.md).
+Two modes:
 
-Flags: --model/--batch/--prompt-len/--new-tokens/--pipeline N to bench the
-pipeline engine instead of batched single-chip decode.
+- **Suite mode** (bare ``python bench.py``, what the driver runs): an
+  orchestrator that runs each measurement in a FRESH subprocess with
+  backend-bring-up retries and a fallback config ladder, then emits one
+  JSON line whose ``detail.rows`` carries every row — the flagship
+  TinyLlama decode rate plus the BASELINE.md north-star rows
+  (Llama-3-8B-Instruct int8/int4 single-chip decode, a 1-stage recurrent
+  ring row).  Designed to be un-losable: a backend-init failure is retried
+  after a sleep in a new interpreter; a config that fails walks down a
+  batch/chunk ladder; a timeout (the known mid-compile wedge trigger on
+  the remote-tunnel backend) stops further device work but still emits
+  whatever was measured; if the TPU never comes up the flagship row runs
+  on the CPU backend, clearly marked.  The process exits 0 with a JSON
+  line on stdout in every one of those cases.
+
+- **Direct mode** (``python bench.py --direct [flags]``): one in-process
+  measurement, used by the suite's children and for manual sweeps.
+  Flags: --model/--batch/--prompt-len/--new-tokens/--pipeline N/
+  --quantize/--kv-dtype/--chunk/--mode prefill/--profile DIR.
+
+Baselines (vs_baseline): TinyLlama-class rows compare against ~7 tokens/s
+aggregate — the 3×Jetson-TX2 TinyLlama rate read off the reference's
+published tokens-vs-time plot (assets/time_vs_tokens_TinyLlama.png; no
+numeric tables exist — BASELINE.md).  Llama-3-8B rows compare against a
+STATED Jetson-class stand-in of 40 tokens/s — the public Jetson AI Lab /
+MLC figure for Llama-3-8B int4 on a Jetson AGX Orin — because the
+reference never ran an 8B model (its TX2 testbed tops out at GPT-2 XL
+1.56B); BASELINE.md's north star asks for >=1.5x a Jetson-Orin-class
+baseline, i.e. >=60 tokens/s.
 """
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 REFERENCE_TOKENS_PER_S = 7.0  # 3×Jetson TX2, TinyLlama, from the plot
+JETSON_8B_TOKENS_PER_S = 40.0  # stated stand-in: AGX Orin Llama-3-8B int4
+NORTH_STAR_MULTIPLE = 1.5  # BASELINE.md: >=1.5x the Jetson-class baseline
 
 
-def main():
-    ap = argparse.ArgumentParser()
+def baseline_for(model: str) -> float:
+    return JETSON_8B_TOKENS_PER_S if "8b" in model.lower() else REFERENCE_TOKENS_PER_S
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--direct", action="store_true",
+                    help="run ONE measurement in-process (suite children use this)")
+    ap.add_argument("--probe", action="store_true",
+                    help="with --direct: only bring up the backend and run a tiny matmul")
+    ap.add_argument("--suite-budget", type=float, default=2700.0,
+                    help="suite mode: stop launching new rows after this many seconds")
+    ap.add_argument("--rows", default=None,
+                    help="suite mode: comma-separated row names to run (default all)")
+    ap.add_argument("--probe-timeout", type=float, default=420.0,
+                    help="suite mode: per-attempt backend probe timeout (s)")
+    ap.add_argument("--backend", choices=("auto", "cpu"), default="auto",
+                    help="cpu: force the CPU backend via jax.config (the "
+                    "JAX_PLATFORMS env var is pinned to the TPU plugin by "
+                    "this image's sitecustomize, so only the config-update "
+                    "route avoids touching a wedged tunnel backend)")
     ap.add_argument("--model", default="tiny-llama-1.1b")
     # decode is weight-bandwidth-bound so throughput grows with batch: v5e
-    # r3 measured 880 (B=8) / 2283 (B=16) / 2727 (B=24) tok/s/chip.  B=32's
-    # compile has wedged the remote-tunnel backend before — stay at 24.
+    # r3 measured 880 (B=8) / 2283 (B=16) / 2727 (B=24) tok/s/chip.
     ap.add_argument("--batch", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=32)
     ap.add_argument("--new-tokens", type=int, default=512)
@@ -58,24 +96,174 @@ def main():
         help="prefill: compare flash-attention prefill latency vs the XLA "
         "path at --prompt-len and verify greedy-token agreement",
     )
-    args = ap.parse_args()
-    if args.chunk is None:
-        args.chunk = 16 if args.pipeline else 256
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="direct mode: wrap the timed run in a jax.profiler trace")
+    return ap
+
+
+# ---------------------------------------------------------------------------
+# Direct mode (one in-process measurement)
+# ---------------------------------------------------------------------------
+
+
+def run_probe():
+    """Backend bring-up check: device enumeration + one tiny compiled op."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    (x @ x).block_until_ready()
+    return {
+        "metric": "backend probe",
+        "value": round(time.perf_counter() - t0, 2),
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "detail": {"backend": jax.default_backend(), "device": str(devs[0])},
+    }
+
+
+def run_prefill(args):
+    """Flash-vs-XLA prefill latency comparison (unchanged from r3)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from mdi_llm_tpu.config import Config
     from mdi_llm_tpu.models import transformer
-
-    dtype = {
-        "bfloat16": jnp.bfloat16,
-        "float16": jnp.float16,
-        "float32": jnp.float32,
-    }[args.dtype]
     from mdi_llm_tpu.cli._common import resolve_kv_dtype
+    from mdi_llm_tpu.generation import Generator
+
+    dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+             "float32": jnp.float32}[args.dtype]
+    kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
+    cfg = Config.from_name(args.model)
+    if args.pipeline:
+        raise SystemExit("--mode prefill benches the single-chip engine; drop --pipeline")
+    if args.quantize != "none":
+        raise SystemExit(
+            "--mode prefill compares against an f32 reference forward, "
+            "which does not exist for a quantized tree; drop --quantize"
+        )
+    if args.prompt_len < 256:
+        raise SystemExit(
+            "--mode prefill needs --prompt-len >= 256 (the flash kernel "
+            "only engages above the small-tile threshold)"
+        )
+    limit = min(args.seq_len, cfg.block_size)
+    if args.prompt_len >= limit:
+        raise SystemExit(
+            f"--prompt-len {args.prompt_len} must leave generation room "
+            f"below min(--seq-len, context window) = {limit}; positions "
+            "past the RoPE cache would be garbage"
+        )
+    if jax.default_backend() != "tpu":
+        print("warning: flash kernel needs TPU; both runs use the XLA path",
+              file=sys.stderr, flush=True)
+
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
+               for _ in range(args.batch)]
+
+    def best_prefill(use_flash):
+        use_flash = use_flash and jax.default_backend() == "tpu"
+        eng = Generator(
+            cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
+            use_flash=use_flash, quantize="none",
+            # force the comparison at exactly --prompt-len (the engine's
+            # auto threshold would silently fall back to XLA below 2k)
+            flash_min_len=256,
+        )
+        eng.generate(prompts, 1, temperature=0.0)  # warmup
+        best = float("inf")
+        for _ in range(3):
+            _, stats = eng.generate(prompts, 1, temperature=0.0)
+            best = min(best, stats.prefill_s)
+        return best
+
+    # Numerics: the two attention implementations accumulate in different
+    # orders, so bf16 token identity is not a meaningful invariant.  The
+    # meaningful check: flash must be no less accurate than the XLA path
+    # against an f32 reference forward (measured r3 on v5e: flash 0.0297 vs
+    # xla 0.0303 rel err — statistically identical).
+    batch_np = np.zeros((args.batch, args.prompt_len), np.int32)
+    for i, p in enumerate(prompts):
+        batch_np[i] = np.asarray(p, np.int32)
+
+    # device-side reductions over the last <=512 prompt positions: full
+    # (B, T, vocab) f32 logit tensors pulled to host would be multi-GB
+    n_check = min(args.prompt_len, 512)
+
+    def prompt_logits(run_params, run_dtype, use_flash):
+        kv0 = transformer.init_kv_cache(
+            cfg, args.batch, args.prompt_len, dtype=run_dtype
+        )
+
+        def fwd(pr, t, kv):
+            logits, _ = transformer.forward(
+                cfg, pr, t, jnp.zeros((args.batch,), jnp.int32), kv=kv,
+                fresh_prefill=True,
+                use_flash=use_flash and jax.default_backend() == "tpu",
+            )
+            return logits[:, -n_check:].astype(jnp.float32)
+
+        return jax.jit(fwd)(run_params, jnp.asarray(batch_np), kv0)
+
+    params_f32 = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), params)
+    lg_ref = prompt_logits(params_f32, jnp.float32, False)
+    del params_f32
+    scale_ = max(1e-6, float(jnp.max(jnp.abs(lg_ref))))
+
+    def check(use_flash):
+        lg = prompt_logits(params, kv_dtype, use_flash)
+        err = float(jnp.max(jnp.abs(lg - lg_ref))) / scale_
+        return err, jnp.argmax(lg, -1)
+
+    err_f, am_f = check(True)
+    err_x, am_x = check(False)
+    del lg_ref
+    agree = float(jnp.mean(am_f == am_x))
+    if err_f > err_x * 1.5 + 1e-3:
+        raise AssertionError(f"flash prefill less accurate than XLA: {err_f} vs {err_x}")
+
+    t_flash = best_prefill(True)
+    t_xla = best_prefill(False)
+    return {
+        "metric": f"prefill latency ({args.model}, B={args.batch}, T={args.prompt_len})",
+        "value": round(min(t_flash, t_xla) * 1000, 2),
+        "unit": "ms",
+        "vs_baseline": round(t_xla / t_flash, 2),
+        "detail": {
+            "flash_ms": round(t_flash * 1000, 2),
+            "xla_ms": round(t_xla * 1000, 2),
+            "flash_speedup": round(t_xla / t_flash, 2),
+            "flash_rel_err_vs_f32": round(err_f, 5),
+            "xla_rel_err_vs_f32": round(err_x, 5),
+            "argmax_agreement_bf16": round(agree, 5),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def run_decode(args):
+    """Batched (or pipeline-ring) decode throughput measurement."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mdi_llm_tpu.config import Config
+    from mdi_llm_tpu.models import transformer
+    from mdi_llm_tpu.cli._common import resolve_kv_dtype
+
+    dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+             "float32": jnp.float32}[args.dtype]
     kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
     cfg = Config.from_name(args.model)
     if args.quantize != "none":
-        # build the int8 tree directly: an 8B-class model never exists in
-        # f32/bf16, so Llama-3-8B fits one v5e chip for quantized benches
+        # build the int8/int4 tree directly: an 8B-class model never exists
+        # in f32/bf16, so Llama-3-8B fits one v5e chip for quantized benches
         from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, init_quantized_params
 
         params = init_quantized_params(
@@ -94,125 +282,6 @@ def main():
         rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
         for _ in range(args.batch)
     ]
-
-    if args.mode == "prefill":
-        from mdi_llm_tpu.generation import Generator
-
-        if args.pipeline:
-            raise SystemExit("--mode prefill benches the single-chip engine; drop --pipeline")
-        if args.quantize != "none":
-            raise SystemExit(
-                "--mode prefill compares against an f32 reference forward, "
-                "which does not exist for a quantized tree; drop --quantize"
-            )
-        if args.prompt_len < 256:
-            raise SystemExit(
-                "--mode prefill needs --prompt-len >= 256 (the flash kernel "
-                "only engages above the small-tile threshold)"
-            )
-        limit = min(args.seq_len, cfg.block_size)
-        if args.prompt_len >= limit:
-            raise SystemExit(
-                f"--prompt-len {args.prompt_len} must leave generation room "
-                f"below min(--seq-len, context window) = {limit}; positions "
-                "past the RoPE cache would be garbage"
-            )
-        if jax.default_backend() != "tpu":
-            print("warning: flash kernel needs TPU; both runs use the XLA path",
-                  flush=True)
-
-        def best_prefill(use_flash):
-            use_flash = use_flash and jax.default_backend() == "tpu"
-            eng = Generator(
-                cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
-                use_flash=use_flash, quantize=quantize,
-                # force the comparison at exactly --prompt-len (the engine's
-                # auto threshold would silently fall back to XLA below 2k)
-                flash_min_len=256,
-            )
-            eng.generate(prompts, 1, temperature=0.0)  # warmup
-            best = float("inf")
-            for _ in range(3):
-                _, stats = eng.generate(prompts, 1, temperature=0.0)
-                best = min(best, stats.prefill_s)
-            return best
-
-        # Numerics: the two attention implementations accumulate in different
-        # orders, so bf16 token identity is not a meaningful invariant
-        # (near-tie argmax flips are expected, especially on random weights
-        # whose logits are near-uniform).  The meaningful check: flash must
-        # be no less accurate than the XLA path against an f32 reference
-        # forward (measured r3 on v5e: flash 0.0297 vs xla 0.0303 rel err —
-        # statistically identical).
-        batch_np = np.zeros((args.batch, args.prompt_len), np.int32)
-        for i, p in enumerate(prompts):
-            batch_np[i] = np.asarray(p, np.int32)
-
-        # device-side reductions over the last <=512 prompt positions: full
-        # (B, T, vocab) f32 logit tensors pulled to host would be multi-GB at
-        # the shapes where flash matters
-        n_check = min(args.prompt_len, 512)
-
-        def prompt_logits(run_params, run_dtype, use_flash):
-            kv0 = transformer.init_kv_cache(
-                cfg, args.batch, args.prompt_len, dtype=run_dtype
-            )
-
-            def fwd(pr, t, kv):
-                logits, _ = transformer.forward(
-                    cfg, pr, t, jnp.zeros((args.batch,), jnp.int32), kv=kv,
-                    fresh_prefill=True,
-                    use_flash=use_flash and jax.default_backend() == "tpu",
-                )
-                # slice inside the jit so only the checked tail is ever
-                # materialized (full (B,T,vocab) f32 is multi-GB at the
-                # shapes where flash matters)
-                return logits[:, -n_check:].astype(jnp.float32)
-
-            return jax.jit(fwd)(run_params, jnp.asarray(batch_np), kv0)
-
-        params_f32 = jax.tree_util.tree_map(
-            lambda a: a.astype(jnp.float32), params
-        )
-        lg_ref = prompt_logits(params_f32, jnp.float32, False)
-        del params_f32
-        scale_ = max(1e-6, float(jnp.max(jnp.abs(lg_ref))))
-
-        def check(use_flash):
-            lg = prompt_logits(params, kv_dtype, use_flash)
-            err = float(jnp.max(jnp.abs(lg - lg_ref))) / scale_
-            return err, jnp.argmax(lg, -1)
-
-        err_f, am_f = check(True)
-        err_x, am_x = check(False)
-        del lg_ref
-        agree = float(jnp.mean(am_f == am_x))
-        assert err_f <= err_x * 1.5 + 1e-3, (
-            f"flash prefill less accurate than XLA: {err_f} vs {err_x}"
-        )
-
-        t_flash = best_prefill(True)
-        t_xla = best_prefill(False)
-        print(
-            json.dumps(
-                {
-                    "metric": f"prefill latency ({args.model}, B={args.batch}, T={args.prompt_len})",
-                    "value": round(min(t_flash, t_xla) * 1000, 2),
-                    "unit": "ms",
-                    "vs_baseline": round(t_xla / t_flash, 2),
-                    "detail": {
-                        "flash_ms": round(t_flash * 1000, 2),
-                        "xla_ms": round(t_xla * 1000, 2),
-                        "flash_speedup": round(t_xla / t_flash, 2),
-                        "flash_rel_err_vs_f32": round(err_f, 5),
-                        "xla_rel_err_vs_f32": round(err_x, 5),
-                        "argmax_agreement_bf16": round(agree, 5),
-                        "device": str(jax.devices()[0]),
-                    },
-                }
-            )
-        )
-        return
 
     if args.pipeline:
         from mdi_llm_tpu.parallel.pipeline import PipelineEngine
@@ -246,32 +315,260 @@ def main():
     # (prompt+max_new bucket), so a shorter warmup would compile a different
     # cache shape and the timed run would recompile inside the measurement
     engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
+    profiler_cm = None
+    if args.profile:
+        profiler_cm = jax.profiler.trace(args.profile)
+        profiler_cm.__enter__()
     t0 = time.perf_counter()
     outs, stats = engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
     wall = time.perf_counter() - t0
+    if profiler_cm is not None:
+        profiler_cm.__exit__(None, None, None)
 
     toks = sum(len(o) - args.prompt_len for o in outs)
     decode_tps = stats.tokens_generated / stats.decode_s if stats.decode_s else 0.0
     n_chips = max(1, args.pipeline)
     value = decode_tps / n_chips
+    base = baseline_for(args.model)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"decode tokens/sec/chip ({args.model}, B={args.batch}, {label})",
-                "value": round(value, 2),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(value / REFERENCE_TOKENS_PER_S, 2),
-                "detail": {
-                    "total_tokens": toks,
-                    "decode_tokens_per_s": round(decode_tps, 2),
-                    "prefill_s": round(stats.prefill_s, 3),
-                    "wall_s": round(wall, 2),
-                    "device": str(jax.devices()[0]),
-                },
-            }
+    return {
+        "metric": f"decode tokens/sec/chip ({args.model}, B={args.batch}, {label})",
+        "value": round(value, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(value / base, 2),
+        "detail": {
+            "total_tokens": toks,
+            "decode_tokens_per_s": round(decode_tps, 2),
+            "prefill_s": round(stats.prefill_s, 3),
+            "wall_s": round(wall, 2),
+            "baseline_tokens_per_s": base,
+            "config": {
+                "model": args.model, "batch": args.batch, "chunk": args.chunk,
+                "quantize": args.quantize, "kv_dtype": args.kv_dtype,
+                "seq_len": args.seq_len, "new_tokens": args.new_tokens,
+                "pipeline": args.pipeline,
+                "samples_per_slot": args.samples_per_slot,
+            },
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def run_direct(args):
+    if args.backend == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.chunk is None:
+        args.chunk = 16 if args.pipeline else 256
+    if args.probe:
+        return run_probe()
+    if args.mode == "prefill":
+        return run_prefill(args)
+    return run_decode(args)
+
+
+# ---------------------------------------------------------------------------
+# Suite mode (orchestrator)
+# ---------------------------------------------------------------------------
+
+# Each row: name, child argv tail, per-attempt timeout, and a fallback
+# ladder of flag overrides walked on (non-backend) failure.  Ordered so the
+# safest/most valuable rows run first: if a later aggressive config wedges
+# the tunnel backend, the recorded artifact already holds the earlier rows.
+SUITE_ROWS = [
+    {
+        "name": "tinyllama-bf16",
+        "headline": True,
+        "flags": ["--batch", "24", "--chunk", "256", "--new-tokens", "512"],
+        "ladder": [["--batch", "16"], ["--batch", "8", "--chunk", "128"]],
+        "timeout": 900,
+    },
+    {  # BASELINE.md north star: Llama-3-8B-Instruct single-chip decode
+        "name": "llama3-8b-int8",
+        "flags": ["--model", "Llama-3-8B-Instruct", "--quantize", "int8",
+                   "--batch", "8", "--seq-len", "512", "--new-tokens", "256"],
+        "ladder": [["--batch", "4"]],
+        "timeout": 1200,
+    },
+    {  # recurrent ring on one chip (the reference's headline execution model)
+        "name": "ring-pipeline-m16",
+        "flags": ["--pipeline", "1", "--samples-per-slot", "16",
+                   "--batch", "16", "--new-tokens", "256"],
+        "ladder": [["--samples-per-slot", "8", "--batch", "8"]],
+        "timeout": 900,
+    },
+    {  # second north-star row: int4 halves the weight bytes again
+        "name": "llama3-8b-int4",
+        "flags": ["--model", "Llama-3-8B-Instruct", "--quantize", "int4",
+                   "--batch", "8", "--seq-len", "512", "--new-tokens", "256"],
+        "ladder": [["--batch", "4"]],
+        "timeout": 1200,
+    },
+    {  # HBM-roof push, last: int8 MXU matmuls at the proven batch (B=32's
+        # compile wedged the tunnel backend in r3 — never re-run it here)
+        "name": "tinyllama-w8a8",
+        "flags": ["--quantize", "w8a8", "--batch", "24", "--chunk", "256",
+                   "--new-tokens", "512"],
+        "ladder": [["--batch", "16"]],
+        "timeout": 900,
+    },
+]
+
+BACKEND_ERR = "Unable to initialize backend"
+
+
+def _child(argv_tail, timeout, env=None):
+    """Run one measurement in a fresh interpreter.  Returns (dict|None, err)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--direct"] + argv_tail
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, **(env or {})},
         )
-    )
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-6:]
+        kind = "backend" if BACKEND_ERR in (proc.stderr or "") + (proc.stdout or "") else "error"
+        return None, f"{kind}: " + " | ".join(tail)
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                break
+    return None, "error: no JSON on stdout"
+
+
+def run_suite(args):
+    t_start = time.perf_counter()
+    rows, events = {}, []
+    wedged = False
+
+    def elapsed():
+        return time.perf_counter() - t_start
+
+    def note(msg):
+        events.append(f"[{elapsed():.0f}s] {msg}")
+        print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+    # --- backend bring-up with retry-after-sleep in fresh interpreters ---
+    tpu_ok = False
+    for attempt in range(4):
+        res, err = _child(["--probe"], timeout=args.probe_timeout)
+        det = (res or {}).get("detail", {})
+        # the tunnel plugin may report its platform as "tpu" or "axon"
+        if res is not None and (
+            det.get("backend") in ("tpu", "axon") or "TPU" in det.get("device", "")
+        ):
+            tpu_ok = True
+            note(f"probe ok in {res['value']}s on {res['detail'].get('device')}")
+            break
+        note(f"probe attempt {attempt + 1} failed: {err or res}")
+        if err == "timeout":
+            # a hung probe means the tunnel is wedged; more probes just queue
+            # behind the wedge — wait once more then give up on TPU
+            if attempt >= 1:
+                break
+        if elapsed() > args.suite_budget / 3:
+            break
+        time.sleep(60)
+
+    selected = None if not args.rows else set(args.rows.split(","))
+
+    if tpu_ok:
+        for row in SUITE_ROWS:
+            if selected and row["name"] not in selected:
+                continue
+            if wedged:
+                rows[row["name"]] = {"error": "skipped: backend presumed wedged"}
+                continue
+            if elapsed() > args.suite_budget:
+                rows[row["name"]] = {"error": "skipped: suite budget exhausted"}
+                continue
+            attempts = [[]] + row.get("ladder", [])
+            result = None
+            for extra in attempts:
+                cfg_flags = row["flags"] + extra
+                res, err = _child(cfg_flags, timeout=row["timeout"])
+                if res is not None:
+                    result = res
+                    note(f"{row['name']}{' ' + ' '.join(extra) if extra else ''}: "
+                         f"{res['value']} {res['unit']}")
+                    break
+                note(f"{row['name']} ({' '.join(cfg_flags)}) failed: {err}")
+                if err == "timeout":
+                    # killing a child mid-compile is the known wedge trigger;
+                    # assume the backend is now unusable and stop device work
+                    wedged = True
+                    result = {"error": "timeout (backend may be wedged)"}
+                    break
+                if err and err.startswith("backend"):
+                    # backend dropped mid-suite: one sleep-and-retry, then
+                    # walk on (fresh interpreter per attempt regardless)
+                    time.sleep(60)
+                if elapsed() > args.suite_budget:
+                    result = {"error": f"gave up (budget): {err}"}
+                    break
+            rows[row["name"]] = result if result is not None else {"error": err}
+    else:
+        note("TPU backend unavailable; running flagship row on CPU fallback")
+        res, err = _child(
+            ["--backend", "cpu", "--batch", "4", "--new-tokens", "48",
+             "--chunk", "16", "--seq-len", "256"],
+            timeout=900,
+        )
+        rows["tinyllama-bf16-cpu-fallback"] = res if res is not None else {"error": err}
+
+    # --- assemble the single output line ---
+    def ok(name):
+        r = rows.get(name)
+        return r if r and "error" not in r else None
+
+    headline = (ok("tinyllama-bf16") or ok("tinyllama-w8a8")
+                or ok("ring-pipeline-m16") or ok("tinyllama-bf16-cpu-fallback"))
+    north = ok("llama3-8b-int8") or ok("llama3-8b-int4")
+    if headline is None and north is not None:
+        headline = north
+    if headline is not None:
+        out = {
+            "metric": headline["metric"],
+            "value": headline["value"],
+            "unit": headline["unit"],
+            "vs_baseline": headline["vs_baseline"],
+        }
+    else:
+        out = {"metric": "decode tokens/sec/chip (no measurement succeeded)",
+               "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0}
+    out["detail"] = {
+        "rows": rows,
+        "north_star": {
+            "target": f">= {NORTH_STAR_MULTIPLE}x Jetson-class 8B baseline "
+                      f"({JETSON_8B_TOKENS_PER_S} tok/s, stated in bench.py)",
+            "met": bool(north and north["vs_baseline"] >= NORTH_STAR_MULTIPLE),
+            "value": north["value"] if north else None,
+            "vs_jetson_8b": north["vs_baseline"] if north else None,
+        },
+        "suite_wall_s": round(elapsed(), 1),
+        "events": events,
+    }
+    return out
+
+
+def main():
+    args = build_parser().parse_args()
+    if args.direct:
+        print(json.dumps(run_direct(args)), flush=True)
+        return
+    try:
+        out = run_suite(args)
+    except Exception as e:  # suite mode must never lose the round's artifact
+        out = {"metric": "decode tokens/sec/chip (suite crashed)", "value": 0.0,
+               "unit": "tokens/s/chip", "vs_baseline": 0.0,
+               "detail": {"error": f"{type(e).__name__}: {e}"}}
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -64,12 +64,12 @@ def main(argv=None):
     if args.n_stages > 1 or args.quantize != "none":
         cfg, params = load_checkpoint(ckpt_dir)
 
-    def write_stages(base_dir, cfg_, params_):
+    def write_stages(base_dir, cfg_, params_, quantize="none"):
         stages = split_params(cfg_, params_, args.n_stages)
         chunk_dir = base_dir / "chunks" / f"{args.n_stages}stages"
         for i, st in enumerate(stages):
             save_checkpoint(st, cfg_, chunk_dir / f"stage_{i}")
-        save_stage_manifest(chunk_dir, cfg_, args.n_stages)
+        save_stage_manifest(chunk_dir, cfg_, args.n_stages, quantize=quantize)
         print(f"wrote {args.n_stages} stage checkpoints → {chunk_dir}")
 
     if args.n_stages > 1:
@@ -92,7 +92,7 @@ def main(argv=None):
                 shutil.copy(src, q_dir / name)
         if args.n_stages > 1:
             # pipeline deployments get pre-quantized stage chunks too
-            write_stages(q_dir, cfg, qp)
+            write_stages(q_dir, cfg, qp, quantize=args.quantize)
         print(f"wrote {args.quantize}-quantized checkpoint → {q_dir}")
     print(f"checkpoint ready: {ckpt_dir}")
     return ckpt_dir

@@ -151,19 +151,31 @@ class Generator:
         mesh: Optional[Mesh] = None,  # GSPMD dp/tp mesh: params laid out
         # under parallel/sharding.py's Megatron rules, XLA inserts the
         # collectives (beyond reference parity — the reference has no
-        # tensor-parallel inference at all, SURVEY.md §2.4)
+        # tensor-parallel inference at all, SURVEY.md §2.4).  A mesh with an
+        # "ep" axis on a MoE config switches the experts to token-dispatch
+        # expert parallelism (parallel/expert.py, all_to_all over ICI)
+        moe_capacity_factor: Optional[float] = None,  # None → exact (no
+        # dropped assignments); a finite factor bounds the EP dispatch
+        # buffers at the cost of Switch-style token drops
     ):
         self.cfg = cfg
         self.mesh = mesh
         self._kv_sharding = None
         self._dp = 1
+        self._moe_impl = None
         if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
             raise ValueError(f"unknown quantize mode {quantize!r}")
-        if mesh is not None and quantize not in (None, "none"):
-            raise ValueError(
-                "quantized trees use custom leaf names the GSPMD sharding "
-                "rules don't cover; drop mesh or quantize"
-            )
+        if mesh is not None:
+            from mdi_llm_tpu.ops.quant import tree_has_quantized
+
+            # structural check, not just the flag: a pre-quantized
+            # checkpoint (prepare_model --quantize) loads with
+            # quantize='none' but its tree still has weight_q/scale leaves
+            if quantize not in (None, "none") or tree_has_quantized(params):
+                raise ValueError(
+                    "quantized trees use custom leaf names the GSPMD sharding "
+                    "rules don't cover; drop the mesh/tp or the quantization"
+                )
         if quantize in FLAG_TO_MODE:
             from mdi_llm_tpu.ops.quant import quantize_params
 
@@ -180,9 +192,27 @@ class Generator:
 
             tp_n = int(mesh.shape.get("tp", 1))
             dp_n = int(mesh.shape.get("dp", 1))
+            ep_n = int(mesh.shape.get("ep", 1))
             # vocab counts here: the Generator tp-shards embeddings/head
             validate_tp_divisibility(cfg, tp_n, check_vocab=True)
-            params = shard_params(params, cfg, mesh, "tp" if tp_n > 1 else None)
+            ep_axis = None
+            if ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE":
+                if cfg.n_expert % ep_n:
+                    raise ValueError(
+                        f"ep={ep_n} does not divide n_expert={cfg.n_expert}"
+                    )
+                from mdi_llm_tpu.parallel.expert import ep_moe_forward
+
+                ep_axis = "ep"
+                self._moe_impl = partial(
+                    ep_moe_forward,
+                    mesh=mesh,
+                    axis="ep",
+                    capacity_factor=moe_capacity_factor,
+                )
+            params = shard_params(
+                params, cfg, mesh, "tp" if tp_n > 1 else None, ep_axis
+            )
             self._dp = dp_n
             # KV cache (L, B, G, S, hs): batch on dp, KV groups on tp
             self._kv_sharding = NamedSharding(
@@ -234,6 +264,7 @@ class Generator:
                     fresh_prefill=True,
                     # flash pays off on big tiles; small buckets stay on XLA
                     use_flash=self.use_flash and T >= self.flash_min_len,
+                    moe_impl=self._moe_impl,
                 )
                 last = jnp.take_along_axis(
                     logits, (true_len - 1)[:, None, None], axis=1
@@ -249,7 +280,8 @@ class Generator:
             @partial(jax.jit, donate_argnums=(2,), static_argnames=("temperature", "top_k", "top_p"))
             def decode(params, tokens, kv, input_pos, key, temperature, top_k, top_p):
                 logits, kv = transformer.forward(
-                    self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope
+                    self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope,
+                    moe_impl=self._moe_impl,
                 )
                 key, sub = jax.random.split(key)
                 tok = sample(
@@ -276,7 +308,8 @@ class Generator:
                 def body(carry, _):
                     tok, kv, pos, key = carry
                     logits, kv = transformer.forward(
-                        self.cfg, params, tok[:, None], pos, kv=kv, rope=self.rope
+                        self.cfg, params, tok[:, None], pos, kv=kv, rope=self.rope,
+                        moe_impl=self._moe_impl,
                     )
                     key, sub = jax.random.split(key)
                     nxt = sample(
@@ -305,7 +338,8 @@ class Generator:
             @partial(jax.jit, donate_argnums=(2,))
             def verify(params, tokens, kv, input_pos):
                 logits, kv = transformer.forward(
-                    self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope
+                    self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope,
+                    moe_impl=self._moe_impl,
                 )
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
 

@@ -72,7 +72,9 @@ def _gelu(cfg: Config, x: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def mlp_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+def mlp_forward(
+    cfg: Config, p: Params, x: jnp.ndarray, moe_impl=None
+) -> jnp.ndarray:
     kind = cfg.mlp_class_name
     if kind == "GptNeoxMLP":
         return linear(_gelu(cfg, linear(x, p["fc"])), p["proj"])
@@ -81,7 +83,7 @@ def mlp_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     if kind == "GemmaMLP":
         return linear(_gelu(cfg, linear(x, p["fc_1"])) * linear(x, p["fc_2"]), p["proj"])
     if kind == "LLaMAMoE":
-        return moe_forward(cfg, p, x)
+        return (moe_impl or moe_forward)(cfg, p, x)
     raise ValueError(f"unknown mlp_class_name {kind!r}")
 
 
@@ -91,8 +93,9 @@ def moe_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
 
     Dense formulation: every expert runs on every token and the router's
     top-k weights (renormalized over the selected experts) zero out the rest.
-    On TPU this keeps shapes static and the MXU busy; for large E an
-    expert-parallel sharded variant lives in `parallel/expert.py`.
+    On TPU this keeps shapes static and the MXU busy; the token-dispatch
+    expert-parallel variant (all_to_all over an `ep` mesh axis) is
+    `parallel/expert.ep_moe_forward`, passed in here via `moe_impl`.
     """
     E = cfg.n_expert
     router = quantized_einsum("...i,ei->...e", x, p["gate"]).astype(jnp.float32)
@@ -262,6 +265,7 @@ def block_forward(
     fresh_prefill: bool = False,
     use_flash: bool = False,
     sp_meta: Optional[Tuple] = None,
+    moe_impl=None,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
     parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms."""
@@ -272,10 +276,10 @@ def block_forward(
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
-        x = x + att + mlp_forward(cfg, p["mlp"], n2)
+        x = x + att + mlp_forward(cfg, p["mlp"], n2, moe_impl)
     else:
         x = x + att
-        x = x + mlp_forward(cfg, p["mlp"], _norm(cfg, x, p["norm_2"]))
+        x = x + mlp_forward(cfg, p["mlp"], _norm(cfg, x, p["norm_2"]), moe_impl)
     return x, k_cache, v_cache
 
 
@@ -293,6 +297,7 @@ def run_blocks(
     fresh_prefill: bool = False,
     use_flash: bool = False,
     sp_meta: Optional[Tuple] = None,
+    moe_impl=None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
     rematerializes each block under autodiff (training memory ∝ 1 layer's
@@ -304,7 +309,7 @@ def run_blocks(
         def body(carry, layer_p):
             y, _, _ = block_forward(
                 cfg, layer_p, carry, pos, cos, sin, None, None, input_pos, sp_axis,
-                fresh_prefill, use_flash,
+                fresh_prefill, use_flash, moe_impl=moe_impl,
             )
             return y, None
 
@@ -318,6 +323,7 @@ def run_blocks(
         y, k_c, v_c = block_forward(
             cfg, layer_p, carry, pos, cos, sin, k_c, v_c, input_pos, sp_axis,
             fresh_prefill=fresh_prefill, use_flash=use_flash, sp_meta=sp_meta,
+            moe_impl=moe_impl,
         )
         return y, (k_c, v_c)
 
@@ -363,6 +369,7 @@ def forward(
     fresh_prefill: bool = False,
     use_flash: bool = False,
     sp_meta: Optional[Tuple] = None,
+    moe_impl=None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
 
@@ -386,7 +393,7 @@ def forward(
     x, kv = run_blocks(
         cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat,
         sp_axis=sp_axis, fresh_prefill=fresh_prefill, use_flash=use_flash,
-        sp_meta=sp_meta,
+        sp_meta=sp_meta, moe_impl=moe_impl,
     )
     return head(cfg, params, x), kv
 

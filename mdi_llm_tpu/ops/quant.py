@@ -66,6 +66,17 @@ def dequantize_tensor(q: np.ndarray, scale: np.ndarray, dtype=np.float32):
 W4_GROUP = 128  # input-axis group size for int4 scales
 
 
+def w4_group_size(in_d: int, group: int = W4_GROUP) -> int:
+    """Largest power-of-two divisor of `in_d` that is <= `group` — the
+    actual int4 scale-group width for an input dim (shared by the real
+    quantizer and the synthetic bench initializer so their scale shapes
+    agree for every in_d)."""
+    g = min(group, in_d)
+    while in_d % g:
+        g //= 2
+    return g
+
+
 def quantize_tensor4(w: np.ndarray, group: int = W4_GROUP):
     """Group-wise symmetric int4, packed two nibbles per int8 byte.
 
@@ -82,9 +93,7 @@ def quantize_tensor4(w: np.ndarray, group: int = W4_GROUP):
     in_d = w.shape[-1]
     if in_d % 2:
         raise ValueError(f"int4 packing needs an even input dim, got {in_d}")
-    g = min(group, in_d)
-    while in_d % g:
-        g //= 2
+    g = w4_group_size(in_d, group)
     wg = w.reshape(*w.shape[:-1], in_d // g, g)
     amax = np.max(np.abs(wg), axis=-1)
     scale = (amax / 7.0).astype(np.float32)
@@ -114,6 +123,17 @@ def is_quantized(p: Params) -> bool:
     return isinstance(p, dict) and (
         "weight_q" in p or "weight_q8" in p or "weight_q4" in p
     )
+
+
+def tree_has_quantized(params: Params) -> bool:
+    """True if any subtree is a quantized linear — detects pre-quantized
+    checkpoints structurally, independent of any --quantize flag (a
+    prepare_model --quantize sibling loads with quantize='none')."""
+    if isinstance(params, dict):
+        return is_quantized(params) or any(
+            tree_has_quantized(v) for v in params.values()
+        )
+    return False
 
 
 def quantize_params(
@@ -170,7 +190,7 @@ def init_quantized_params(cfg, seed: int = 0, mode: str = "w8", dtype=None):
         if mode == "w4":
             # random packed nibbles in [-8, 7]; rms 4.61 → matching scale
             packed = rng.integers(-128, 128, (L, out_d, in_d // 2), dtype=np.int8)
-            g = min(W4_GROUP, in_d)
+            g = w4_group_size(in_d)  # same halving rule as quantize_tensor4
             return {
                 wkey: packed,
                 "scale": np.full((L, out_d, in_d // g), s / 4.61, np.float32),
@@ -259,7 +279,13 @@ def _w4_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     """
     xin, out = spec.split("->")
     x_sub, w_sub = xin.split(",")
-    assert x_sub[-1] == w_sub[-1] and "g" not in spec and "k" not in spec, spec
+    if x_sub[-1] != w_sub[-1] or "g" in spec or "k" in spec:
+        # explicit raise (not assert): the contract must survive python -O,
+        # or an unsupported spec would silently contract the wrong axes
+        raise NotImplementedError(
+            f"_w4_einsum requires a last-subscript contraction and reserves "
+            f"letters 'g'/'k' for the group axes; got {spec!r}"
+        )
     packed, scale = p["weight_q4"], p["scale"]
     nG = scale.shape[-1]
     Gh = packed.shape[-1] // nG  # per-plane group width

@@ -1,0 +1,168 @@
+"""Token-dispatch expert parallelism (EP) over an `ep` mesh axis.
+
+The reference's MoE (`/root/reference/src/sub/model.py:823-853`, `LLaMAMoE`)
+routes each token through its top-k experts on ONE device — experts are
+never sharded (SURVEY.md §2.4 "Expert parallelism: absent").  The dense
+TPU formulation (`models/transformer.moe_forward`) runs every expert on
+every token, which keeps shapes static but burns `n_expert`× FLOPs per
+token.  This module is the sparse, sharded redesign — the GShard/Switch
+dispatch pattern, TPU-native:
+
+- experts are sharded over the `ep` axis (leading expert-axis shard, same
+  layout `parallel/sharding.param_specs(ep_axis=...)` produces);
+- tokens are split across `ep` devices; each device routes its shard
+  (top-k + renormalize, identical math to the dense path), packs tokens
+  into a per-expert capacity-bounded dispatch buffer, and exchanges the
+  buffers with `jax.lax.all_to_all` over ICI;
+- each device runs ONLY its local experts on the tokens routed to them
+  (SwiGLU, same einsum contractions as the dense path, so quantized expert
+  trees work unchanged), then a second `all_to_all` returns the outputs to
+  the tokens' home devices for the weighted combine.
+
+Capacity: per (expert, source-device) slots
+`C = max(1, ceil(cf * n_local * k / E))`.  With `capacity_factor=None`
+capacity is exact (`C = n_local`, the worst case where every local token
+picks the same expert) — zero drops, bit-comparable to the dense path, the
+right default for decode where `n_local` is tiny.  A finite factor bounds
+the buffers (total expert FLOPs ≈ `cf·k/E` of dense) and silently drops
+overflow assignments — dropped assignments simply contribute nothing to
+the combine (their router weight is lost, matching Switch-Transformer
+semantics), so throughput-oriented prefill can trade exactness for speed.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.ops.quant import quantized_einsum
+
+Params = Any
+
+
+def expert_capacity(
+    cfg: Config, n_local: int, capacity_factor: Optional[float]
+) -> int:
+    """Per-(expert, source-device) dispatch slots."""
+    if capacity_factor is None:
+        return max(1, n_local)
+    need = capacity_factor * n_local * cfg.n_expert_per_token / cfg.n_expert
+    return max(1, math.ceil(need))
+
+
+def _local_moe(cfg: Config, ep: int, C: int, axis: str, xs, valid, p):
+    """Per-device body (inside shard_map): route, dispatch, compute, combine.
+
+    xs: (1, n, D) local token shard; valid: (1, n) bool (False for padding
+    rows, which must neither consume capacity nor emit output); p: mlp param
+    dict with experts' leading axis sharded to the local E/ep slice.
+    """
+    x = xs[0]
+    n, D = x.shape
+    E, k = cfg.n_expert, cfg.n_expert_per_token
+    E_loc = E // ep
+
+    # -- routing: identical math to the dense path (transformer.moe_forward)
+    router = quantized_einsum("ni,ei->ne", x, p["gate"]).astype(jnp.float32)
+    probs = jax.nn.softmax(router, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)  # (n, k)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+    flat_e = topi.reshape(-1)  # (n*k,) global expert ids
+    vmask = valid[0].reshape(-1)  # (n,)
+    flat_valid = jnp.repeat(vmask, k)  # (n*k,)
+    flat_w = jnp.where(flat_valid, topv.reshape(-1), 0.0)
+    flat_tok = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+
+    # -- capacity assignment: rank of each assignment within its expert
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32) * flat_valid[:, None]
+    ranks = jnp.cumsum(onehot, axis=0) - onehot  # (n*k, E)
+    pos_in_e = jnp.take_along_axis(ranks, flat_e[:, None], axis=1)[:, 0]
+    keep = (pos_in_e < C) & flat_valid
+    pos_c = jnp.minimum(pos_in_e, C - 1)
+    contrib = keep.astype(x.dtype)
+
+    # -- pack the dispatch buffer: (E, C, D); dropped/padded assignments add 0
+    disp = jnp.zeros((E, C, D), x.dtype).at[flat_e, pos_c].add(
+        x[flat_tok] * contrib[:, None]
+    )
+
+    # -- ship token slices to their experts' owner devices (experts are
+    # owner-major on the leading axis: expert e lives on device e // E_loc)
+    recv = jax.lax.all_to_all(
+        disp.reshape(ep, E_loc, C, D), axis, split_axis=0, concat_axis=0
+    )  # (ep=source device, E_loc, C, D)
+    buf = recv.transpose(1, 0, 2, 3).reshape(E_loc, ep * C, D)
+
+    # -- local experts only: same contractions as the dense path, so the
+    # quantized storage layouts dispatch identically
+    pe = p["experts"]
+    h1 = quantized_einsum("emd,eid->emi", buf, pe["fc_1"])
+    h2 = quantized_einsum("emd,eid->emi", buf, pe["fc_2"])
+    h = jax.nn.silu(h1) * h2
+    outb = quantized_einsum("emi,edi->emd", h, pe["proj"])
+
+    # -- return trip + weighted combine at each token's home device
+    back = jax.lax.all_to_all(
+        outb.reshape(E_loc, ep, C, D).transpose(1, 0, 2, 3),
+        axis, split_axis=0, concat_axis=0,
+    )  # (ep=expert owner, E_loc, C, D)
+    outd = back.reshape(E, C, D)
+    y = outd[flat_e, pos_c] * (flat_w[:, None] * contrib[:, None]).astype(x.dtype)
+    out = jnp.zeros((n, D), x.dtype).at[flat_tok].add(y)
+    return out[None]
+
+
+def ep_moe_forward(
+    cfg: Config,
+    p: Params,
+    x: jnp.ndarray,  # (B, T, D)
+    mesh: Mesh,
+    axis: str = "ep",
+    capacity_factor: Optional[float] = None,
+) -> jnp.ndarray:
+    """Expert-parallel MoE layer: drop-in for `transformer.moe_forward`
+    (pass as `moe_impl=` through `transformer.forward`).  Tokens are split
+    over the `axis` devices, experts dispatched via all_to_all; output is
+    replicated like the input."""
+    ep = int(mesh.shape[axis])
+    E = cfg.n_expert
+    if E % ep:
+        raise ValueError(f"n_expert={E} not divisible by {axis}={ep}")
+    B, T, D = x.shape
+    N = B * T
+    n_loc = -(-N // ep)
+    Np = n_loc * ep
+    C = expert_capacity(cfg, n_loc, capacity_factor)
+
+    xf = x.reshape(N, D)
+    if Np > N:
+        xf = jnp.pad(xf, ((0, Np - N), (0, 0)))
+    xs = xf.reshape(ep, n_loc, D)
+    valid = (jnp.arange(Np) < N).reshape(ep, n_loc)
+
+    def leaf_spec(shard_first):
+        return lambda a: P(axis, *([None] * (a.ndim - 1))) if shard_first else P(
+            *([None] * a.ndim)
+        )
+
+    p_specs = {
+        "gate": jax.tree_util.tree_map(leaf_spec(False), p["gate"]),
+        "experts": jax.tree_util.tree_map(leaf_spec(True), p["experts"]),
+    }
+    body = partial(_local_moe, cfg, ep, C, axis)
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None), p_specs),
+        out_specs=P(axis, None, None),
+        check_vma=False,
+    )(xs, valid, {"gate": p["gate"], "experts": p["experts"]})
+    return out.reshape(Np, D)[:N].reshape(B, T, D)

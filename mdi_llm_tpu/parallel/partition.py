@@ -152,8 +152,12 @@ def unpad_stage_blocks(stage_blocks: Params, counts: Sequence[int]) -> Params:
     return jax.tree_util.tree_map(unsplit, stage_blocks)
 
 
-def save_stage_manifest(out_dir, cfg: Config, n_stages: int, **kw) -> Path:
-    """Write `stage_map.json` describing the partition (≡ split_map.json)."""
+def save_stage_manifest(
+    out_dir, cfg: Config, n_stages: int, quantize: str = "none", **kw
+) -> Path:
+    """Write `stage_map.json` describing the partition (≡ split_map.json).
+    `quantize` records the chunks' storage mode so tooling can tell an int4
+    chunk dir from bf16 without relying on directory-name convention."""
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     manifest = {
@@ -161,6 +165,7 @@ def save_stage_manifest(out_dir, cfg: Config, n_stages: int, **kw) -> Path:
         "n_layer": cfg.n_layer,
         "stage_layers": stage_layers(cfg.n_layer, n_stages, **kw),
         "model": cfg.name,
+        "quantize": quantize,
     }
     p = out_dir / "stage_map.json"
     p.write_text(json.dumps(manifest, indent=2) + "\n")

@@ -101,22 +101,32 @@ class PipelineEngine:
         # guard must see the mesh-derived tp, not just the tp argument
         if mesh is None:
             n_dev = len(devices or jax.devices())
-            if tp < 1 or n_dev % tp:
+            if tp < 1:
+                raise ValueError(f"tp={tp} must be a positive device count")
+            if n_stages is None and n_dev % tp:
                 raise ValueError(
-                    f"tp={tp} must be a positive divisor of the {n_dev} "
-                    "available devices"
+                    f"tp={tp} must divide the {n_dev} available devices "
+                    "when n_stages is not given"
                 )
+            # with explicit n_stages only the first n_stages*tp devices are
+            # used; make_mesh's total<=n_dev check covers the rest
             mesh = pipeline_mesh(n_stages or n_dev // tp, devices, tp=tp)
         self.mesh = mesh
         S = int(mesh.shape["pipe"])
         self.n_stages = S
         self.tp = int(mesh.shape.get("tp", 1))
         validate_tp_divisibility(cfg, self.tp)
-        if self.tp > 1 and quantize not in (None, "none"):
-            raise ValueError(
-                "quantized trees use custom leaf names the tp sharding rules "
-                "don't cover; drop tp or quantize"
-            )
+        if self.tp > 1:
+            from mdi_llm_tpu.ops.quant import tree_has_quantized
+
+            # structural check, not just the flag: a pre-quantized
+            # checkpoint loads with quantize='none' but still has
+            # weight_q/scale leaves the tp specs can't map
+            if quantize not in (None, "none") or tree_has_quantized(params):
+                raise ValueError(
+                    "quantized trees use custom leaf names the tp sharding "
+                    "rules don't cover; drop tp or the quantization"
+                )
         if quantize in FLAG_TO_MODE:
             params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
         if cache_dtype is None:

@@ -119,10 +119,17 @@ def validate_tp_divisibility(cfg: Config, tp: int, check_vocab: bool = False):
         raise ValueError(f"tp={tp} does not divide {', '.join(bad)} of {cfg.name}")
 
 
-def shard_params(params: Any, cfg: Config, mesh: Mesh, tp_axis: Optional[str] = "tp"):
-    """Place a params pytree onto `mesh` under the TP rules."""
+def shard_params(
+    params: Any,
+    cfg: Config,
+    mesh: Mesh,
+    tp_axis: Optional[str] = "tp",
+    ep_axis: Optional[str] = None,
+):
+    """Place a params pytree onto `mesh` under the TP/EP rules."""
     tp = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
-    specs = param_specs(cfg, tp)
+    ep = ep_axis if (ep_axis and ep_axis in mesh.axis_names) else None
+    specs = param_specs(cfg, tp, ep)
     return jax.tree_util.tree_map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
     )

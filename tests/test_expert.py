@@ -1,0 +1,195 @@
+"""Expert parallelism (parallel/expert.py): golden parity vs the dense MoE.
+
+The dense path (`transformer.moe_forward`) is itself pinned against the
+reference `LLaMAMoE` semantics (`/root/reference/src/sub/model.py:823-853`)
+by test_model/test_quant; here the token-dispatch all_to_all variant must
+reproduce it:
+
+- layer-level parity on an 8-device `ep` mesh (exact capacity → no drops),
+  for E=8/k=2 (Mixtral-shaped) and E=4/k=1 (switch-style);
+- capacity semantics: a cf-bounded buffer drops overflow assignments and
+  only then (checked against a host-side reference dropper);
+- full-model decode parity through `transformer.forward(moe_impl=...)`;
+- Generator-level greedy decode parity (ep mesh vs single device).
+"""
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.models.transformer import init_params, moe_forward
+from mdi_llm_tpu.parallel.expert import ep_moe_forward, expert_capacity
+from mdi_llm_tpu.parallel.mesh import make_mesh
+
+
+def moe_config(E=8, k=2, **kw):
+    base = dict(
+        name="ep-test",
+        block_size=64,
+        vocab_size=128,
+        padded_vocab_size=128,
+        n_layer=2,
+        n_head=4,
+        n_embd=32,
+        n_query_groups=4,
+        rotary_percentage=1.0,
+        parallel_residual=False,
+        bias=False,
+        norm_class_name="RMSNorm",
+        mlp_class_name="LLaMAMoE",
+        n_expert=E,
+        n_expert_per_token=k,
+        intermediate_size=48,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def moe_layer_params(cfg, seed=0):
+    """One layer's mlp param dict (no leading layer axis), f32."""
+    rng = np.random.default_rng(seed)
+    E, D, I = cfg.n_expert, cfg.n_embd, cfg.intermediate_size
+
+    def w(*shape):
+        return jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 0.05)
+
+    return {
+        "gate": {"weight": w(E, D)},
+        "experts": {
+            "fc_1": {"weight": w(E, I, D)},
+            "fc_2": {"weight": w(E, I, D)},
+            "proj": {"weight": w(E, D, I)},
+        },
+    }
+
+
+@pytest.mark.parametrize("E,k,ep", [(8, 2, 8), (8, 2, 4), (4, 1, 2)])
+def test_layer_parity_exact_capacity(devices, E, k, ep):
+    cfg = moe_config(E=E, k=k)
+    p = moe_layer_params(cfg)
+    mesh = make_mesh({"ep": ep}, devices[:ep])
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((3, 5, cfg.n_embd)).astype(np.float32))
+
+    dense = moe_forward(cfg, p, x)
+    sparse = ep_moe_forward(cfg, p, x, mesh, capacity_factor=None)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense), atol=2e-5)
+
+
+def test_layer_parity_under_jit(devices):
+    cfg = moe_config()
+    p = moe_layer_params(cfg)
+    mesh = make_mesh({"ep": 8}, devices)
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 8, cfg.n_embd)).astype(np.float32)
+    )
+    fn = jax.jit(lambda pp, xx: ep_moe_forward(cfg, pp, xx, mesh))
+    np.testing.assert_allclose(
+        np.asarray(fn(p, x)), np.asarray(moe_forward(cfg, p, x)), atol=2e-5
+    )
+
+
+def _host_reference_with_drops(cfg, p, x, ep, C):
+    """NumPy re-implementation of capacity-bounded routing: same top-k and
+    renormalization as the dense path, but assignments past C per
+    (expert, source-device) contribute nothing."""
+    B, T, D = x.shape
+    N = B * T
+    n_loc = math.ceil(N / ep)
+    xf = np.zeros((n_loc * ep, D), np.float32)
+    xf[:N] = np.asarray(x, np.float32).reshape(N, D)
+    gate = np.asarray(p["gate"]["weight"], np.float32)
+    out = np.zeros_like(xf)
+    for d in range(ep):
+        counts = {e: 0 for e in range(cfg.n_expert)}
+        for i in range(d * n_loc, (d + 1) * n_loc):
+            if i >= N:
+                continue
+            logits = gate @ xf[i]
+            probs = np.exp(logits - logits.max())
+            probs /= probs.sum()
+            top = np.argsort(-probs, kind="stable")[: cfg.n_expert_per_token]
+            w = probs[top] / probs[top].sum()
+            for e, wv in zip(top, w):
+                if counts[e] >= C:
+                    continue
+                counts[e] += 1
+                fc1 = np.asarray(p["experts"]["fc_1"]["weight"][e], np.float32)
+                fc2 = np.asarray(p["experts"]["fc_2"]["weight"][e], np.float32)
+                pr = np.asarray(p["experts"]["proj"]["weight"][e], np.float32)
+                h1 = fc1 @ xf[i]
+                h = h1 / (1 + np.exp(-h1)) * (fc2 @ xf[i])
+                out[i] += wv * (pr @ h)
+    return out[:N].reshape(B, T, D)
+
+
+def test_capacity_drops_match_host_reference(devices):
+    cfg = moe_config(E=4, k=2)
+    p = moe_layer_params(cfg, seed=3)
+    ep = 2
+    mesh = make_mesh({"ep": ep}, devices[:ep])
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((1, 12, cfg.n_embd)).astype(np.float32))
+    cf = 0.5  # force drops: capacity < assignments for popular experts
+    C = expert_capacity(cfg, 6, cf)
+    assert C < 6  # the test is vacuous unless the buffer can overflow
+
+    got = ep_moe_forward(cfg, p, x, mesh, capacity_factor=cf)
+    want = _host_reference_with_drops(cfg, p, x, ep, C)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+    # and the dropped assignments must make it differ from the dense output
+    dense = moe_forward(cfg, p, x)
+    assert float(jnp.abs(dense - got).max()) > 1e-4
+
+
+def test_full_forward_with_moe_impl(devices):
+    """transformer.forward(moe_impl=ep_moe_forward) ≡ dense forward."""
+    cfg = moe_config()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh({"ep": 4}, devices[:4])
+    tokens = jnp.arange(12, dtype=jnp.int32).reshape(2, 6) % cfg.vocab_size
+    pos0 = jnp.zeros((2,), jnp.int32)
+
+    dense_logits, _ = transformer.forward(cfg, params, tokens, pos0)
+    impl = partial(ep_moe_forward, mesh=mesh)
+    ep_logits, _ = transformer.forward(cfg, params, tokens, pos0, moe_impl=impl)
+    np.testing.assert_allclose(
+        np.asarray(ep_logits), np.asarray(dense_logits), atol=3e-5
+    )
+
+
+def test_generator_ep_decode_parity(devices):
+    """Greedy decode through Generator on an ep mesh equals single-device."""
+    from mdi_llm_tpu.generation import Generator
+
+    cfg = moe_config()
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    prompts = [[3, 7, 11, 2], [5, 1, 9, 13, 4]]
+
+    ref, _ = Generator(cfg, params, max_seq_length=64).generate(
+        prompts, 12, temperature=0.0
+    )
+    mesh = make_mesh({"ep": 8}, devices)
+    eng = Generator(cfg, params, max_seq_length=64, mesh=mesh)
+    # the ep mesh must actually engage token dispatch, not dense fallback
+    assert eng._moe_impl is not None
+    got, _ = eng.generate(prompts, 12, temperature=0.0)
+    assert got == ref
+    # and the compiled decode step must contain the all_to_all exchange
+    import jax as _jax
+
+    decode = eng._decode_fn(2)
+    kv = transformer.init_kv_cache(cfg, 2, 64)
+    lowered = decode.lower(
+        eng.params, jnp.zeros((2, 1), jnp.int32), kv,
+        jnp.zeros((2,), jnp.int32), _jax.random.PRNGKey(0),
+        temperature=0.0, top_k=None, top_p=None,
+    )
+    txt = lowered.as_text()
+    assert "all_to_all" in txt or "all-to-all" in txt
