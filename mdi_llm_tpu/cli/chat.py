@@ -4,6 +4,12 @@
 tokens as they decode (incremental re-decode so multi-byte/merged tokens
 print correctly, chat.py:36-54), keep the conversation in the KV window by
 accumulating turn tokens, stop on the style's stop sequences.
+
+Streaming backends: single device (default), tensor-parallel
+(`--tp-devices N`), or the recurrent pipeline ring (`--pipeline-stages N`)
+— the last matching the reference's distributed chat experience where the
+starter surfaces tokens as they come back around the ring
+(gptserver.py:904-956).
 """
 
 from __future__ import annotations
@@ -21,7 +27,7 @@ from mdi_llm_tpu.cli._common import (
     setup_logging,
 )
 from mdi_llm_tpu.config import TEMPERATURE, TOP_K
-from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.generation import Generator, detect_stop_tokens
 
 
 def build_parser():
@@ -38,6 +44,20 @@ def build_parser():
         help="tensor-parallel streaming over N devices (GSPMD Megatron "
         "sharding; cuts per-token latency for models too big for one chip)",
     )
+    ap.add_argument(
+        "--pipeline-stages",
+        type=int,
+        default=0,
+        help="stream over an N-stage recurrent pipeline ring (layer-sharded "
+        "stages; tokens surface as stage 0 collects them)",
+    )
+    ap.add_argument(
+        "--rotations-per-call",
+        type=int,
+        default=2,
+        help="pipeline streaming: ring rotations batched per dispatch — "
+        "smaller = lower time-to-first-byte, larger = higher throughput",
+    )
     return ap
 
 
@@ -45,20 +65,40 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     setup_logging(args)
     select_device(args)
+    if args.tp_devices and args.pipeline_stages:
+        raise SystemExit(
+            "--tp-devices and --pipeline-stages are separate streaming "
+            "backends; for a pipe x tp mesh use cli/starter.py"
+        )
     cfg, params, tokenizer, prompt_style = load_model(args)
     if tokenizer is None:
         raise SystemExit("chat needs a checkpoint with a tokenizer (--ckpt)")
     stop_seqs = prompt_style.stop_tokens(tokenizer)
-    mesh = None
-    if args.tp_devices:
-        from mdi_llm_tpu.cli._common import make_tp_mesh
 
-        mesh = make_tp_mesh(args.tp_devices, args.quantize)
-    gen = Generator(
-        cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed,
-        quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
-        mesh=mesh,
-    )
+    if args.pipeline_stages:
+        from mdi_llm_tpu.parallel.pipeline import PipelineEngine
+
+        eng = PipelineEngine(
+            cfg,
+            params,
+            n_stages=args.pipeline_stages,
+            max_seq_length=args.sequence_length,
+            rng_seed=args.seed,
+            quantize=args.quantize,
+            cache_dtype=resolve_kv_dtype(args.kv_dtype),
+            rotations_per_call=args.rotations_per_call,
+        )
+    else:
+        mesh = None
+        if args.tp_devices:
+            from mdi_llm_tpu.cli._common import make_tp_mesh
+
+            mesh = make_tp_mesh(args.tp_devices, args.quantize)
+        eng = Generator(
+            cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed,
+            quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
+            mesh=mesh,
+        )
 
     print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")
     history: list[int] = []
@@ -72,29 +112,68 @@ def main(argv=None):
             break
         turn = tokenizer.encode(prompt_style.apply(user)).tolist()
         context = history + turn
-        limit = gen.max_seq_length - args.n_tokens - 1
+        limit = eng.max_seq_length - args.n_tokens - 1
         if len(context) > limit > 0:
             context = context[-limit:]  # slide the window
 
         reply_ids: list[int] = []
         printed = ""
+
+        def emit_tok(tok: int):
+            nonlocal printed
+            reply_ids.append(tok)
+            # incremental re-decode (≡ chat.py:174-200): print only the
+            # newly stabilized suffix
+            text = tokenizer.decode(np.asarray(reply_ids))
+            if text.startswith(printed):
+                sys.stdout.write(text[len(printed) :])
+                sys.stdout.flush()
+                printed = text
+
         try:
-            for tok in gen.generate_chat(
-                context,
-                args.n_tokens,
-                temperature=args.temperature,
-                top_k=args.top_k,
-                top_p=args.top_p,
-                stop_sequences=stop_seqs,
-            ):
-                reply_ids.append(tok)
-                # incremental re-decode (≡ chat.py:174-200): print only the
-                # newly stabilized suffix
-                text = tokenizer.decode(np.asarray(reply_ids))
-                if text.startswith(printed):
-                    sys.stdout.write(text[len(printed) :])
-                    sys.stdout.flush()
-                    printed = text
+            if args.pipeline_stages:
+                # stream via the ring's collect callback, holding back
+                # potential stop-sequence prefixes (≡ generate_chat's
+                # buffering) — the engine's returned list is authoritative
+                # and flushes any held remainder below
+                hold = max(0, max((len(s) for s in stop_seqs), default=0) - 1)
+                streamed: list[int] = []
+                stopped = False
+
+                def on_tok(_j: int, tok: int):
+                    nonlocal stopped
+                    if stopped:
+                        return
+                    streamed.append(tok)
+                    if detect_stop_tokens(streamed, stop_seqs):
+                        stopped = True
+                        return
+                    while len(reply_ids) < len(streamed) - hold:
+                        emit_tok(streamed[len(reply_ids)])
+
+                outs, _ = eng.generate(
+                    [context],
+                    args.n_tokens,
+                    temperature=args.temperature,
+                    top_k=args.top_k,
+                    top_p=args.top_p,
+                    stop_sequences=stop_seqs,
+                    stream_cb=on_tok,
+                )
+                final = outs[0][len(context) :]
+                for tok in final[len(reply_ids) :]:
+                    emit_tok(tok)
+                reply_ids = final
+            else:
+                for tok in eng.generate_chat(
+                    context,
+                    args.n_tokens,
+                    temperature=args.temperature,
+                    top_k=args.top_k,
+                    top_p=args.top_p,
+                    stop_sequences=stop_seqs,
+                ):
+                    emit_tok(tok)
         except KeyboardInterrupt:
             print("\n[interrupted]")
         print()

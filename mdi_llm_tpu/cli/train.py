@@ -50,6 +50,13 @@ def build_parser():
     ap.add_argument("--patience", type=int, default=5)
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument(
+        "--use-flash",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="attention via the Pallas flash kernel (fwd + FA-2 backward); "
+        "auto = TPU backend only",
+    )
+    ap.add_argument(
         "--mesh",
         default=None,
         help='e.g. "dp=8", "dp=4,tp=2", "dp=2,sp=4" (ring attention), or '
@@ -100,6 +107,7 @@ def main(argv=None):
         seed=args.seed,
         dtype=args.dtype if args.dtype != "float16" else "bfloat16",
         remat=not args.no_remat,
+        use_flash={"auto": None, "on": True, "off": False}[args.use_flash],
     )
     mesh = parse_mesh(args.mesh)
     out_dir = Path(args.ckpt) if args.ckpt else Path("out")

@@ -381,7 +381,9 @@ def forward(
 
     `fresh_prefill` (caller contract: input_pos == 0, cache empty) attends
     over the chunk itself rather than the cache buffer, enabling the Pallas
-    flash kernel via `use_flash` (inference only — no custom VJP yet).
+    flash kernel via `use_flash`.  The kernel carries a custom VJP
+    (FlashAttention-2 recompute backward, ops/flash.py), so `use_flash`
+    also composes with `remat`/`jax.grad` for training.
     """
     B, T = tokens.shape
     pos = input_pos[:, None] + jnp.arange(T, dtype=input_pos.dtype)[None, :]

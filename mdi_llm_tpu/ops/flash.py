@@ -1,21 +1,28 @@
-"""Pallas TPU flash attention (causal self-attention prefill).
+"""Pallas TPU flash attention (causal self-attention, fwd + custom VJP).
 
-The reference delegates its fused attention to torch SDPA/cuDNN
-(`/root/reference/src/sub/model.py:738-751`); this is the TPU-native
-equivalent for the O(T²) prefill path: a Pallas kernel that streams K/V
-blocks through VMEM with an online softmax, never materializing the (T, T)
-score matrix.  GQA is handled by mapping each query head's grid slot to its
-KV group in the BlockSpec index maps.
+The reference delegates its fused attention to torch SDPA/cuDNN — and runs
+it in training and eval alike (`/root/reference/src/sub/model.py:738-751`);
+this is the TPU-native equivalent for the O(T²) path: a Pallas kernel that
+streams K/V blocks through VMEM with an online softmax, never materializing
+the (T, T) score matrix.  GQA is handled by mapping each query head's grid
+slot to its KV group in the BlockSpec index maps.
+
+Training support comes from a `jax.custom_vjp`: the forward saves
+(q, k, v, o, lse) and the backward is the FlashAttention-2 recompute — a
+dQ kernel (grid over query tiles, streaming K/V) and a dK/dV kernel (grid
+over key tiles, streaming Q/dO), with per-query-head dK/dV summed over
+each GQA group outside the kernel.  Not differentiating simply runs the
+primal kernel — inference pays nothing for the VJP machinery.
 
 Scope: causal self-attention over one fresh chunk (q_pos == k_pos ==
 arange(T)) — exactly the generation prefill and training shapes.  Decode
 (T=1) is memory-bound and stays on the XLA path.  Falls back automatically
 unless running on TPU (or `interpret=True` for CPU tests).
 
-Kernel structure (per pallas_guide.md): grid (B, H, Tq/BQ); each program
-holds one (BQ, hs) query tile in VMEM and fori-loops over K tiles up to the
-causal frontier with running (m, l, acc) scratch.
-"""
+Kernel structure (per pallas_guide.md): grid (B, H, Tq/BQ) (bwd-dKV:
+(B, H, Tk/BK)); each program holds one query (key) tile in VMEM and
+fori-loops over the other operand's tiles up to the causal frontier with
+running f32 scratch."""
 
 from __future__ import annotations
 
@@ -30,9 +37,11 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, seq_len):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_k, seq_len):
     # blocks carry leading (1, 1) batch/head dims: q_ref (1,1,BQ,hs),
-    # k_ref/v_ref (1,1,Tk,hs), o_ref (1,1,BQ,hs)
+    # k_ref/v_ref (1,1,Tk,hs), o_ref (1,1,BQ,hs).  With lse_ref (the
+    # VJP-forward variant) the per-query logsumexp is also written for the
+    # FlashAttention-2 backward.
     block_q = q_ref.shape[2]
     hs = q_ref.shape[3]
     qi = pl.program_id(2)
@@ -74,11 +83,272 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, seq_len):
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
     o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # VJP-forward variant: per-query logsumexp for the FA-2 backward
+        lse_ref[0, 0, :] = jnp.where(
+            l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF
+        )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
-)
+def _pad_shapes(T: int, block_q: int, block_k: int):
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    T_pad = ((T + block_q - 1) // block_q) * block_q
+    T_pad = ((T_pad + block_k - 1) // block_k) * block_k
+    return T_pad, block_q, block_k
+
+
+def _pad_t(x: jnp.ndarray, T_pad: int) -> jnp.ndarray:
+    T = x.shape[2]
+    if T_pad == T:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[2] = (0, T_pad - T)
+    return jnp.pad(x, pad)
+
+
+def _qtile_spec(block_q, hs):
+    return pl.BlockSpec(
+        (1, 1, block_q, hs), lambda b, h, i: (b, h, i, 0), memory_space=pltpu.VMEM
+    )
+
+
+def _full_spec(T_pad, hs, q_per_kv=None):
+    if q_per_kv is None:
+        return pl.BlockSpec(
+            (1, 1, T_pad, hs), lambda b, h, i: (b, h, 0, 0), memory_space=pltpu.VMEM
+        )
+    return pl.BlockSpec(
+        (1, 1, T_pad, hs),
+        lambda b, h, i, _q=q_per_kv: (b, h // _q, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _flash_call(scale, block_q, block_k, interpret, seq_len, q, k, v, with_lse):
+    """Shared primal/forward pallas_call; q/k/v already T-padded, `seq_len`
+    is the true (unpadded) length for masking."""
+    B, H, T_pad, hs = q.shape
+    G = k.shape[1]
+    q_per_kv = H // G
+    # one kernel body for both variants: pallas passes lse_ref positionally
+    # only when a second output is declared
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_k=block_k, seq_len=seq_len
+    )
+    out_shape = [jax.ShapeDtypeStruct((B, H, T_pad, hs), q.dtype)]
+    out_specs = [_qtile_spec(block_q, hs)]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((B, H, T_pad), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i),
+                         memory_space=pltpu.VMEM)
+        )
+    res = pl.pallas_call(
+        kernel,
+        grid=(B, H, T_pad // block_q),
+        in_specs=[
+            _qtile_spec(block_q, hs),
+            _full_spec(T_pad, hs, q_per_kv),
+            _full_spec(T_pad, hs, q_per_kv),
+        ],
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shape if with_lse else out_shape[0],
+        interpret=interpret,
+    )(q, k, v)
+    return res if with_lse else (res, None)
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref, *, scale, block_k, seq_len
+):
+    """dQ tile: stream K/V blocks up to the causal frontier.
+    dS = P ∘ (dO·Vᵀ − D);  dQ = scale · dS · K."""
+    block_q = q_ref.shape[2]
+    hs = q_ref.shape[3]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :]
+    dsum = dsum_ref[0, 0, :]
+    acc0 = jnp.zeros((block_q, hs), jnp.float32)
+    num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+
+    def body(kb, acc):
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        q_idx = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_idx = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (k_idx <= q_idx) & (k_idx < seq_len)
+        p = jnp.exp(jnp.minimum(s - lse[:, None], 80.0))
+        p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum[:, None])
+        return acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    acc = jax.lax.fori_loop(0, num_k_blocks, body, acc0)
+    dq_ref[0, 0, :, :] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, dsum_ref, dk_ref, dv_ref,
+    *, scale, block_q, seq_len, n_q_blocks,
+):
+    """dK/dV tile (per QUERY head; group-summed outside): stream Q/dO
+    blocks from the first one that sees this key tile.
+    dV = Pᵀ·dO;  dK = scale · dSᵀ·Q."""
+    block_k = k_ref.shape[2]
+    hs = k_ref.shape[3]
+    ki = pl.program_id(2)
+    k_start = ki * block_k
+
+    k_t = k_ref[0, 0, :, :].astype(jnp.float32)
+    v_t = v_ref[0, 0, :, :].astype(jnp.float32)
+    dk0 = jnp.zeros((block_k, hs), jnp.float32)
+    dv0 = jnp.zeros((block_k, hs), jnp.float32)
+    first_qb = k_start // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        d_blk = dsum_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = scale * jax.lax.dot_general(
+            q_blk, k_t, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        q_idx = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (k_idx <= q_idx) & (k_idx < seq_len) & (q_idx < seq_len)
+        p = jnp.exp(jnp.minimum(s - lse_blk[:, None], 80.0))
+        p = jnp.where(mask, p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_t, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - d_blk[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(first_qb, n_q_blocks, body, (dk0, dv0))
+    dk_ref[0, 0, :, :] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, with_lse):
+    B, H, T, hs = q.shape
+    T_pad, block_q, block_k = _pad_shapes(T, block_q, block_k)
+    qp, kp, vp = _pad_t(q, T_pad), _pad_t(k, T_pad), _pad_t(v, T_pad)
+    out, lse = _flash_call(scale, block_q, block_k, interpret, T, qp, kp, vp, with_lse)
+    out = out[:, :, :T, :]
+    return (out, lse) if with_lse else out  # lse stays T_pad-wide (bwd re-pads q)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_core(scale, block_q, block_k, interpret, q, k, v):
+    return _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, False)
+
+
+def _flash_core_fwd(scale, block_q, block_k, interpret, q, k, v):
+    out, lse = _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, True)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    B, H, T, hs = q.shape
+    G = k.shape[1]
+    q_per_kv = H // G
+    T_pad, block_q, block_k = _pad_shapes(T, block_q, block_k)
+
+    # D_i = dO_i · O_i (f32), padded rows contribute zero
+    dsum = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qp, kp, vp = _pad_t(q, T_pad), _pad_t(k, T_pad), _pad_t(v, T_pad)
+    dop = _pad_t(do.astype(q.dtype), T_pad)
+    dsum_p = _pad_t(dsum, T_pad)
+    lse_p = lse  # produced at T_pad width by the forward
+
+    lse_tile = pl.BlockSpec(
+        (1, 1, block_q), lambda b, h, i: (b, h, i), memory_space=pltpu.VMEM
+    )
+    lse_full = pl.BlockSpec(
+        (1, 1, T_pad), lambda b, h, i: (b, h, 0), memory_space=pltpu.VMEM
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, scale=scale, block_k=block_k, seq_len=T
+        ),
+        grid=(B, H, T_pad // block_q),
+        in_specs=[
+            _qtile_spec(block_q, hs),
+            _full_spec(T_pad, hs, q_per_kv),
+            _full_spec(T_pad, hs, q_per_kv),
+            _qtile_spec(block_q, hs),
+            lse_tile,
+            lse_tile,
+        ],
+        out_specs=_qtile_spec(block_q, hs),
+        out_shape=jax.ShapeDtypeStruct((B, H, T_pad, hs), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, dsum_p)
+
+    ktile = pl.BlockSpec(
+        (1, 1, block_k, hs),
+        lambda b, h, i, _q=q_per_kv: (b, h // _q, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dkv_out = pl.BlockSpec(
+        (1, 1, block_k, hs), lambda b, h, i: (b, h, i, 0), memory_space=pltpu.VMEM
+    )
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, scale=scale, block_q=block_q, seq_len=T,
+            n_q_blocks=T_pad // block_q,
+        ),
+        grid=(B, H, T_pad // block_k),
+        in_specs=[
+            ktile,
+            ktile,
+            _full_spec(T_pad, hs),
+            _full_spec(T_pad, hs),
+            lse_full,
+            lse_full,
+        ],
+        out_specs=(dkv_out, dkv_out),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, T_pad, hs), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T_pad, hs), jnp.float32),
+        ),
+        interpret=interpret,
+    )(kp, vp, qp, dop, lse_p, dsum_p)
+
+    # GQA: each query head of a group produced its own dK/dV share
+    dk = dk_h.reshape(B, G, q_per_kv, T_pad, hs).sum(2)[:, :, :T].astype(k.dtype)
+    dv = dv_h.reshape(B, G, q_per_kv, T_pad, hs).sum(2)[:, :, :T].astype(v.dtype)
+    return dq[:, :, :T, :], dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
 def flash_attention(
     q: jnp.ndarray,  # (B, n_head, T, hs)
     k: jnp.ndarray,  # (B, n_groups, T, hs)
@@ -88,52 +358,15 @@ def flash_attention(
     block_k: int = 256,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Causal flash self-attention; returns (B, n_head, T, hs)."""
+    """Causal flash self-attention; returns (B, n_head, T, hs).
+
+    Differentiable: reverse-mode AD takes the FlashAttention-2 recompute
+    backward (Pallas dQ / dK-dV kernels) instead of unfusing the forward,
+    so training never materializes the (T, T) score matrix either."""
     B, H, T, hs = q.shape
-    _, G, Tk, _ = k.shape
-    assert T == Tk, "flash path is self-attention over one chunk"
+    Tk = k.shape[2]
+    if T != Tk:
+        raise ValueError("flash path is self-attention over one chunk")
     if scale is None:
         scale = 1.0 / (hs**0.5)
-    q_per_kv = H // G
-
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    # pad T to a multiple of the blocks (masked out via seq_len)
-    T_pad = ((T + block_q - 1) // block_q) * block_q
-    T_pad = ((T_pad + block_k - 1) // block_k) * block_k
-    if T_pad != T:
-        pad = [(0, 0), (0, 0), (0, T_pad - T), (0, 0)]
-        q = jnp.pad(q, pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
-
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, block_k=block_k, seq_len=T
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(B, H, T_pad // block_q),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, block_q, hs),
-                lambda b, h, i: (b, h, i, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, T_pad, hs),
-                lambda b, h, i, _q=q_per_kv: (b, h // _q, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, T_pad, hs),
-                lambda b, h, i, _q=q_per_kv: (b, h // _q, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, hs), lambda b, h, i: (b, h, i, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, H, T_pad, hs), q.dtype),
-        interpret=interpret,
-    )(q, k, v)
-    return out[:, :, :T, :]
+    return _flash_core(float(scale), int(block_q), int(block_k), bool(interpret), q, k, v)

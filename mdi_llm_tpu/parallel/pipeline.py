@@ -486,9 +486,20 @@ class PipelineEngine:
         top_k: Optional[int] = TOP_K,
         top_p: Optional[float] = None,
         stop_sequences: Sequence[Sequence[int]] = (),
+        stream_cb=None,
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for n_samples prompts using recurrent
         pipeline parallelism with continuous sample scheduling.
+
+        `stream_cb(sample_idx, token)` is invoked per generated token as its
+        emission is collected from the ring (≡ the reference starter
+        surfacing tokens as they arrive, gptserver.py:904-956).  Tokens
+        stream in collection order — per chunk of ring rotations in the
+        steady state, so latency-sensitive callers should lower
+        `rotations_per_call`.  Like the Generator's callback, tokens past a
+        sample's stop sequence are not streamed, but the final
+        stop-sequence tokens themselves are (the returned lists are
+        truncated; streaming consumers buffer-and-trim, see cli/chat.py).
 
         The first n_stages × samples_per_slot prompts are prefilled in
         parallel and seeded onto the ring; whenever an in-flight sample
@@ -506,7 +517,7 @@ class PipelineEngine:
             return [], stats
         results = self._generate_continuous(
             list(prompts), max_new_tokens, temperature, top_k, top_p,
-            stop_sequences, stats, t_all,
+            stop_sequences, stats, t_all, stream_cb,
         )
         stats.decode_s = time.perf_counter() - t_all - stats.prefill_s
         stats.tokens_generated = sum(
@@ -545,7 +556,8 @@ class PipelineEngine:
         return self._empty_chunk_cache[n_rot]
 
     def _generate_continuous(
-        self, prompts, max_new_tokens, temperature, top_k, top_p, stop_sequences, stats, t_all
+        self, prompts, max_new_tokens, temperature, top_k, top_p,
+        stop_sequences, stats, t_all, stream_cb=None,
     ):
         S, M = self.n_stages, self.M
         N = len(prompts)
@@ -585,6 +597,12 @@ class PipelineEngine:
 
         out = [list(p) for p in prompts]
         done = [False] * N
+
+        def accept(j, tok):
+            """Append one generated token and surface it to the stream."""
+            out[j].append(tok)
+            if stream_cb is not None:
+                stream_cb(j, tok)
 
         def budget(j):
             """Remaining tokens sample j may still emit."""
@@ -639,7 +657,7 @@ class PipelineEngine:
 
         for (g, m), tok in firsts.items():
             j = g * M + m
-            out[j].append(tok)
+            accept(j, tok)
             if detect_stop_tokens(out[j][lens[j] :], stop_sequences) or budget(j) <= 0:
                 done[j] = True
             else:
@@ -684,7 +702,7 @@ class PipelineEngine:
             )
             for lane, tok in firsts.items():
                 j = lane_of[lane]
-                out[j].append(tok)
+                accept(j, tok)
                 if (
                     detect_stop_tokens(out[j][lens[j] :], stop_sequences)
                     or budget(j) <= 0
@@ -771,7 +789,7 @@ class PipelineEngine:
                     j = fed_map.get((s, m))
                     if j is None or not v_row[m] or done[j]:
                         continue
-                    out[j].append(int(t_row[m]))
+                    accept(j, int(t_row[m]))
                     if (
                         detect_stop_tokens(out[j][lens[j] :], stop_sequences)
                         or budget(j) <= 0

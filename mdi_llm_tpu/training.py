@@ -70,6 +70,12 @@ class TrainingConfig:
     seed: int = 10137
     dtype: str = "bfloat16"  # params/compute dtype
     remat: bool = True
+    # run attention through the Pallas flash kernel (fwd + FA-2 backward,
+    # ops/flash.py) instead of the XLA einsum path.  None → auto (TPU
+    # backend only).  ≡ the reference training through fused SDPA
+    # (model.py:738-751).  sp training keeps the ring-attention path (its
+    # blockwise online softmax already avoids the (T, T) materialization).
+    use_flash: Optional[bool] = None
 
 
 def get_lr(it: int, tc: TrainingConfig) -> float:
@@ -86,7 +92,9 @@ def get_lr(it: int, tc: TrainingConfig) -> float:
     return tc.min_lr + coeff * (tc.learning_rate - tc.min_lr)
 
 
-def cross_entropy_loss(cfg: Config, params, tokens, targets, remat=True):
+def cross_entropy_loss(
+    cfg: Config, params, tokens, targets, remat=True, use_flash=False
+):
     """Mean next-token CE in f32 (vocab padding columns get -inf'd out by
     the softmax normalizer naturally since their logits are finite but the
     targets never point at them)."""
@@ -96,6 +104,7 @@ def cross_entropy_loss(cfg: Config, params, tokens, targets, remat=True):
         tokens,
         jnp.zeros((tokens.shape[0],), jnp.int32),
         remat=remat,
+        use_flash=use_flash,
     )
     logits = logits.astype(jnp.float32)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
@@ -174,6 +183,22 @@ class Trainer:
         self.out_dir = Path(out_dir) if out_dir else None
         self.iter_num = 0
         self.best_val_loss = float("inf")
+        # flash kernel needs a real TPU unless explicitly forced (tests
+        # trace with use_flash=True to pin the kernel into the jaxpr).
+        # Auto also requires an unmeshed trainer: under jit-with-shardings
+        # GSPMD has no partitioning rule for the pallas custom call (the
+        # sp/pp paths route attention differently and never pass use_flash)
+        self.use_flash = (
+            jax.default_backend() == "tpu" and mesh is None
+            if tc.use_flash is None
+            else tc.use_flash
+        )
+        if self.use_flash and mesh is not None:
+            raise ValueError(
+                "use_flash=True cannot combine with a training mesh: GSPMD "
+                "cannot partition the Pallas flash call; drop the mesh or "
+                "set use_flash=False/None"
+            )
         dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[tc.dtype]
 
         key = jax.random.PRNGKey(tc.seed)
@@ -379,7 +404,9 @@ class Trainer:
         else:
 
             def loss_fn(params, x, y):
-                return cross_entropy_loss(cfg, params, x, y, remat=tc.remat)
+                return cross_entropy_loss(
+                    cfg, params, x, y, remat=tc.remat, use_flash=self.use_flash
+                )
 
         def step(params, opt_state, xs, ys):
             # gradient accumulation: scan micro-batches, mean the grads
@@ -420,7 +447,9 @@ class Trainer:
         else:
 
             def ev(params, x, y):
-                return cross_entropy_loss(cfg, params, x, y, remat=False)
+                return cross_entropy_loss(
+                    cfg, params, x, y, remat=False, use_flash=self.use_flash
+                )
 
         if self.mesh is None:
             return jax.jit(ev)

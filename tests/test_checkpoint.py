@@ -42,6 +42,7 @@ def test_orbax_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.smoke
 @pytest.mark.parametrize("gqa", [False, True])
 def test_hf_llama_logit_parity(tmp_path, gqa):
     torch = pytest.importorskip("torch")

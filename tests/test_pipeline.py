@@ -41,6 +41,7 @@ def _single(engine, prompts, n):
     return outs
 
 
+@pytest.mark.smoke
 @pytest.mark.parametrize("n_stages", [1, 2, 4])
 def test_pipeline_matches_single_device(model, single_engine, n_stages, devices):
     cfg, params = model
@@ -87,6 +88,45 @@ def test_pipeline_stop_sequences(model, single_engine, devices):
     stop = [free[0][len(PROMPTS[0]) + 3]]  # 4th generated token of sample 0
     got, _ = eng.generate(PROMPTS[:2], 8, temperature=0.0, stop_sequences=[stop])
     assert got[0] == free[0][: len(PROMPTS[0]) + 3]
+
+
+def test_pipeline_stream_cb(model, single_engine, devices):
+    """stream_cb surfaces every generated token, in order, per sample —
+    including across waves (more samples than lanes) — and the returned
+    (trimmed) token lists are a prefix of what streamed."""
+    cfg, params = model
+    eng = PipelineEngine(
+        cfg, params, mesh=pipeline_mesh(2, devices[:2]), cache_dtype=jnp.float32
+    )
+    streamed = {j: [] for j in range(len(PROMPTS))}
+    got, _ = eng.generate(
+        PROMPTS, 8, temperature=0.0,
+        stream_cb=lambda j, t: streamed[j].append(t),
+    )
+    want = _single(single_engine, PROMPTS, 8)
+    assert got == want
+    for j, o in enumerate(got):
+        gen = o[len(PROMPTS[j]) :]
+        assert streamed[j] == gen  # no stop sequences → stream == result
+
+
+def test_pipeline_stream_cb_with_stops(model, single_engine, devices):
+    """With a stop sequence, the stream covers at least the kept tokens and
+    at most the kept tokens + the stop marker (never beyond)."""
+    cfg, params = model
+    eng = PipelineEngine(
+        cfg, params, mesh=pipeline_mesh(2, devices[:2]), cache_dtype=jnp.float32
+    )
+    free = _single(single_engine, PROMPTS[:2], 8)
+    stop = [free[0][len(PROMPTS[0]) + 3]]
+    streamed = {0: [], 1: []}
+    got, _ = eng.generate(
+        PROMPTS[:2], 8, temperature=0.0, stop_sequences=[stop],
+        stream_cb=lambda j, t: streamed[j].append(t),
+    )
+    kept0 = got[0][len(PROMPTS[0]) :]
+    assert streamed[0][: len(kept0)] == kept0
+    assert len(streamed[0]) <= len(kept0) + len(stop)
 
 
 @pytest.mark.parametrize("n_samples", [4, 3])

@@ -27,6 +27,7 @@ def model():
 PROMPTS = [[3, 1, 4, 1, 5, 9, 2], [2, 7]]
 
 
+@pytest.mark.smoke
 @pytest.mark.parametrize("n_devices", [2, 4])
 def test_sp_generation_matches_single_device(model, n_devices, devices):
     cfg, params = model
@@ -70,6 +71,51 @@ def test_sp_long_context_beyond_one_shard(model, devices):
     Tl = -(-_bucket(len(prompt)) // n_dev)
     C = Tl + -(-new // n_dev)
     assert C < len(prompt) + new
+
+
+def test_sp_prompt_shorter_than_mesh(model, devices):
+    """Prompt with fewer tokens than sp devices: most devices hold ONLY
+    sentinel (empty) cache slots after prefill — masking must keep them
+    invisible and the last-token gather must find the right owner."""
+    cfg, params = model
+    single = Generator(cfg, params, cache_dtype=jnp.float32)
+    want, _ = single.generate([[7, 3, 2]], 10, temperature=0.0)
+    sp = SPGenerator(cfg, params, devices=devices, cache_dtype=jnp.float32)  # 8-way
+    got, _ = sp.generate([[7, 3, 2]], 10, temperature=0.0)
+    assert got == want
+
+
+@pytest.mark.parametrize("new", [16, 17, 15])
+def test_sp_cache_full_boundary(model, new, devices):
+    """Round-robin append up to the very last local cache slot: max_new set
+    so the final written slot is exactly C-1 (new % P == 0), one past a row
+    boundary (new % P == 1), and one short of it (new % P == P-1)."""
+    cfg, params = model
+    n_dev = 4
+    single = Generator(cfg, params, cache_dtype=jnp.float32)
+    want, _ = single.generate(PROMPTS[:1], new, temperature=0.0)
+    sp = SPGenerator(cfg, params, devices=devices[:n_dev], cache_dtype=jnp.float32)
+    got, _ = sp.generate(PROMPTS[:1], new, temperature=0.0)
+    assert got == want
+    # the run must actually have reached the last row of the shard budget
+    from mdi_llm_tpu.generation import _bucket
+
+    Tl = -(-_bucket(len(PROMPTS[0])) // n_dev)
+    C = Tl + -(-new // n_dev)
+    last_loc = Tl + (new - 1 - 1) // n_dev  # last decode-step write
+    assert last_loc in (C - 1, C - 2)
+
+
+def test_sp_mixed_length_batch(model, devices):
+    """Samples whose last prompt tokens live on different sp devices (the
+    per-sample owner gather in prefill) generate in one batch correctly."""
+    cfg, params = model
+    prompts = [[5] * 2, [6] * 19, [7] * 11]
+    single = Generator(cfg, params, cache_dtype=jnp.float32)
+    want, _ = single.generate(prompts, 8, temperature=0.0)
+    sp = SPGenerator(cfg, params, devices=devices[:4], cache_dtype=jnp.float32)
+    got, _ = sp.generate(prompts, 8, temperature=0.0)
+    assert got == want
 
 
 def test_sp_gqa_variant(devices):

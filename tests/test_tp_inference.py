@@ -33,6 +33,7 @@ def single(model):
     return Generator(cfg, params, cache_dtype=jnp.float32)
 
 
+@pytest.mark.smoke
 def test_tp_matches_single_device(model, single, devices):
     cfg, params = model
     want, _ = single.generate(PROMPTS, 12, temperature=0.0)
