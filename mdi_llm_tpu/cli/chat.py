@@ -27,7 +27,7 @@ from mdi_llm_tpu.cli._common import (
     setup_logging,
 )
 from mdi_llm_tpu.config import TEMPERATURE, TOP_K
-from mdi_llm_tpu.generation import Generator, detect_stop_tokens
+from mdi_llm_tpu.generation import Generator, StopPrefixFilter
 
 
 def build_parser():
@@ -132,24 +132,14 @@ def main(argv=None):
 
         try:
             if args.pipeline_stages:
-                # stream via the ring's collect callback, holding back
-                # potential stop-sequence prefixes (≡ generate_chat's
-                # buffering) — the engine's returned list is authoritative
-                # and flushes any held remainder below
-                hold = max(0, max((len(s) for s in stop_seqs), default=0) - 1)
-                streamed: list[int] = []
-                stopped = False
+                # stream via the ring's collect callback through the shared
+                # stop-prefix hold-back (same filter as generate_chat) —
+                # the engine's returned list is authoritative and flushes
+                # any held remainder below
+                filt = StopPrefixFilter(stop_seqs, emit_tok)
 
                 def on_tok(_j: int, tok: int):
-                    nonlocal stopped
-                    if stopped:
-                        return
-                    streamed.append(tok)
-                    if detect_stop_tokens(streamed, stop_seqs):
-                        stopped = True
-                        return
-                    while len(reply_ids) < len(streamed) - hold:
-                        emit_tok(streamed[len(reply_ids)])
+                    filt.push(tok)
 
                 outs, _ = eng.generate(
                     [context],

@@ -72,6 +72,41 @@ def find_eot(tokens: Sequence[int], stop_sequences: Sequence[Sequence[int]]) -> 
     return best
 
 
+class StopPrefixFilter:
+    """Streaming stop-sequence suppression, shared by every streaming
+    surface (generate_chat, pipeline chat): tokens are pushed as sampled;
+    all but the trailing max_stop-1 (a potential stop-sequence prefix) are
+    released to `emit`, and once a full stop sequence appears the stream
+    ends without ever emitting any part of the marker."""
+
+    def __init__(self, stop_sequences: Sequence[Sequence[int]], emit):
+        self.stop_sequences = stop_sequences
+        self.emit = emit
+        self.hold = max(0, max((len(s) for s in stop_sequences), default=0) - 1)
+        self.seen: List[int] = []
+        self.emitted = 0
+        self.stopped = False
+
+    def push(self, tok: int) -> None:
+        if self.stopped:
+            return
+        self.seen.append(tok)
+        if detect_stop_tokens(self.seen, self.stop_sequences):
+            self.stopped = True
+            return
+        while self.emitted < len(self.seen) - self.hold:
+            self.emit(self.seen[self.emitted])
+            self.emitted += 1
+
+    def flush(self) -> None:
+        """End of stream without a stop: release the held-back tail."""
+        if self.stopped:
+            return
+        while self.emitted < len(self.seen):
+            self.emit(self.seen[self.emitted])
+            self.emitted += 1
+
+
 def ngram_draft(tokens: Sequence[int], k: int, ngram: int = 3) -> List[int]:
     """Prompt-lookup drafting for speculative decoding: find the most recent
     earlier occurrence of the trailing `ngram` tokens and propose the k
@@ -567,18 +602,18 @@ class Generator:
             raise ValueError("streaming generates one sample; use a tp-only mesh")
 
         def _iter():
-            max_stop = max((len(s) for s in stop_sequences), default=0)
-            pending: List[int] = []
+            ready: List[int] = []
+            filt = StopPrefixFilter(stop_sequences, ready.append)
             for t in self._generate_stream(
                 prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
             ):
-                pending.append(t)
-                if detect_stop_tokens(pending, stop_sequences):
+                filt.push(t)
+                yield from ready
+                ready.clear()
+                if filt.stopped:
                     return
-                # hold back max_stop-1 tokens that could begin a stop sequence
-                while len(pending) > max(0, max_stop - 1):
-                    yield pending.pop(0)
-            yield from pending
+            filt.flush()
+            yield from ready
 
         return _iter()
 

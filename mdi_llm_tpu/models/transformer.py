@@ -233,7 +233,9 @@ def attention_forward(
     if sp_axis is not None:
         from mdi_llm_tpu.ops.ring_attention import ring_attention
 
-        y = ring_attention(q, k_att, v_att, pos, k_pos, sp_axis)
+        # cache-less sp path (training / eval): q_pos == k_pos == the local
+        # contiguous chunk, so the diagonal block may run the flash kernel
+        y = ring_attention(q, k_att, v_att, pos, k_pos, sp_axis, use_flash=use_flash)
     elif use_flash and kv_valid is None and T > 1:
         from mdi_llm_tpu.ops.flash import flash_attention
 

