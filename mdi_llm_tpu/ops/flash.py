@@ -37,11 +37,14 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_k, seq_len):
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_k, seq_len, causal=True
+):
     # blocks carry leading (1, 1) batch/head dims: q_ref (1,1,BQ,hs),
     # k_ref/v_ref (1,1,Tk,hs), o_ref (1,1,BQ,hs).  With lse_ref (the
     # VJP-forward variant) the per-query logsumexp is also written for the
-    # FlashAttention-2 backward.
+    # FlashAttention-2 backward.  causal=False attends the whole chunk
+    # (ring attention's off-diagonal blocks).
     block_q = q_ref.shape[2]
     hs = q_ref.shape[3]
     qi = pl.program_id(2)
@@ -54,7 +57,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_k, s
     acc0 = jnp.zeros((block_q, hs), jnp.float32)
 
     # causal frontier: last K block index that any query in this tile sees
-    num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+    T_pad = k_ref.shape[2]
+    if causal:
+        num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+    else:
+        num_k_blocks = T_pad // block_k
 
     def body(kb, carry):
         m, l, acc = carry
@@ -67,7 +74,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_k, s
         k_idx = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        mask = (k_idx <= q_idx) & (k_idx < seq_len)
+        mask = k_idx < seq_len
+        if causal:
+            mask &= k_idx <= q_idx
         s = jnp.where(mask, s, NEG_INF)
 
         m_chunk = jnp.max(s, axis=1)
@@ -135,7 +144,7 @@ def _full_spec(T_pad, hs, q_per_kv=None):
     )
 
 
-def _flash_call(scale, block_q, block_k, interpret, seq_len, q, k, v, with_lse):
+def _flash_call(scale, block_q, block_k, interpret, causal, seq_len, q, k, v, with_lse):
     """Shared primal/forward pallas_call; q/k/v already T-padded, `seq_len`
     is the true (unpadded) length for masking."""
     B, H, T_pad, hs = q.shape
@@ -144,7 +153,7 @@ def _flash_call(scale, block_q, block_k, interpret, seq_len, q, k, v, with_lse):
     # one kernel body for both variants: pallas passes lse_ref positionally
     # only when a second output is declared
     kernel = functools.partial(
-        _flash_kernel, scale=scale, block_k=block_k, seq_len=seq_len
+        _flash_kernel, scale=scale, block_k=block_k, seq_len=seq_len, causal=causal
     )
     out_shape = [_sds((B, H, T_pad, hs), q.dtype, q)]
     out_specs = [_qtile_spec(block_q, hs)]
@@ -170,7 +179,8 @@ def _flash_call(scale, block_q, block_k, interpret, seq_len, q, k, v, with_lse):
 
 
 def _flash_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref, *, scale, block_k, seq_len
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
+    *, scale, block_k, seq_len, causal=True,
 ):
     """dQ tile: stream K/V blocks up to the causal frontier.
     dS = P ∘ (dO·Vᵀ − D);  dQ = scale · dS · K."""
@@ -184,7 +194,10 @@ def _flash_dq_kernel(
     lse = lse_ref[0, 0, :]
     dsum = dsum_ref[0, 0, :]
     acc0 = jnp.zeros((block_q, hs), jnp.float32)
-    num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+    if causal:
+        num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+    else:
+        num_k_blocks = k_ref.shape[2] // block_k
 
     def body(kb, acc):
         k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
@@ -196,7 +209,9 @@ def _flash_dq_kernel(
         k_idx = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        mask = (k_idx <= q_idx) & (k_idx < seq_len)
+        mask = k_idx < seq_len
+        if causal:
+            mask &= k_idx <= q_idx
         p = jnp.exp(jnp.minimum(s - lse[:, None], 80.0))
         p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
@@ -213,7 +228,7 @@ def _flash_dq_kernel(
 
 def _flash_dkv_kernel(
     k_ref, v_ref, q_ref, do_ref, lse_ref, dsum_ref, dk_ref, dv_ref,
-    *, scale, block_q, seq_len, n_q_blocks,
+    *, scale, block_q, seq_len, n_q_blocks, causal=True,
 ):
     """dK/dV tile (per QUERY head; group-summed outside): stream Q/dO
     blocks from the first one that sees this key tile.
@@ -227,7 +242,7 @@ def _flash_dkv_kernel(
     v_t = v_ref[0, 0, :, :].astype(jnp.float32)
     dk0 = jnp.zeros((block_k, hs), jnp.float32)
     dv0 = jnp.zeros((block_k, hs), jnp.float32)
-    first_qb = k_start // block_q
+    first_qb = k_start // block_q if causal else 0
 
     def body(qb, carry):
         dk, dv = carry
@@ -242,7 +257,9 @@ def _flash_dkv_kernel(
             jnp.int32, (block_q, block_k), 0
         )
         k_idx = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = (k_idx <= q_idx) & (k_idx < seq_len) & (q_idx < seq_len)
+        mask = (k_idx < seq_len) & (q_idx < seq_len)
+        if causal:
+            mask &= k_idx <= q_idx
         p = jnp.exp(jnp.minimum(s - lse_blk[:, None], 80.0))
         p = jnp.where(mask, p, 0.0)
         dv = dv + jax.lax.dot_general(
@@ -262,30 +279,34 @@ def _flash_dkv_kernel(
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
-def _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, with_lse):
+def _flash_fwd_impl(scale, block_q, block_k, interpret, causal, q, k, v, with_lse):
     B, H, T, hs = q.shape
     T_pad, block_q, block_k = _pad_shapes(T, block_q, block_k)
     qp, kp, vp = _pad_t(q, T_pad), _pad_t(k, T_pad), _pad_t(v, T_pad)
-    out, lse = _flash_call(scale, block_q, block_k, interpret, T, qp, kp, vp, with_lse)
+    out, lse = _flash_call(
+        scale, block_q, block_k, interpret, causal, T, qp, kp, vp, with_lse
+    )
     out = out[:, :, :T, :]
     return (out, lse) if with_lse else out  # lse stays T_pad-wide (bwd re-pads q)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _flash_core(scale, block_q, block_k, interpret, q, k, v):
-    return _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash_core(scale, block_q, block_k, interpret, causal, q, k, v):
+    return _flash_fwd_impl(scale, block_q, block_k, interpret, causal, q, k, v, False)
 
 
-def _flash_core_fwd(scale, block_q, block_k, interpret, q, k, v):
-    out, lse = _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, True)
+def _flash_core_fwd(scale, block_q, block_k, interpret, causal, q, k, v):
+    out, lse = _flash_fwd_impl(
+        scale, block_q, block_k, interpret, causal, q, k, v, True
+    )
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(scale, block_q, block_k, interpret, res, do):
-    return _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, None)
+def _flash_core_bwd(scale, block_q, block_k, interpret, causal, res, do):
+    return _flash_bwd_impl(scale, block_q, block_k, interpret, causal, res, do, None)
 
 
-def _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, dlse):
+def _flash_bwd_impl(scale, block_q, block_k, interpret, causal, res, do, dlse):
     """FA-2 backward; `dlse` (B, H, T) is the optional cotangent of the
     logsumexp output (flash_attention_lse).  It folds into the kernels for
     free: ∂lse_i/∂s_ij = P_ij, so ds = P∘(dP − D) + dlse·P
@@ -314,7 +335,8 @@ def _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, dlse):
 
     dq = pl.pallas_call(
         functools.partial(
-            _flash_dq_kernel, scale=scale, block_k=block_k, seq_len=T
+            _flash_dq_kernel, scale=scale, block_k=block_k, seq_len=T,
+            causal=causal,
         ),
         grid=(B, H, T_pad // block_q),
         in_specs=[
@@ -341,7 +363,7 @@ def _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, dlse):
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
             _flash_dkv_kernel, scale=scale, block_q=block_q, seq_len=T,
-            n_q_blocks=T_pad // block_q,
+            n_q_blocks=T_pad // block_q, causal=causal,
         ),
         grid=(B, H, T_pad // block_k),
         in_specs=[
@@ -369,20 +391,24 @@ def _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, dlse):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _flash_lse_core(scale, block_q, block_k, interpret, q, k, v):
-    out, lse = _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, True)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash_lse_core(scale, block_q, block_k, interpret, causal, q, k, v):
+    out, lse = _flash_fwd_impl(
+        scale, block_q, block_k, interpret, causal, q, k, v, True
+    )
     return out, lse[:, :, : q.shape[2]]
 
 
-def _flash_lse_core_fwd(scale, block_q, block_k, interpret, q, k, v):
-    out, lse = _flash_fwd_impl(scale, block_q, block_k, interpret, q, k, v, True)
+def _flash_lse_core_fwd(scale, block_q, block_k, interpret, causal, q, k, v):
+    out, lse = _flash_fwd_impl(
+        scale, block_q, block_k, interpret, causal, q, k, v, True
+    )
     return (out, lse[:, :, : q.shape[2]]), (q, k, v, out, lse)
 
 
-def _flash_lse_core_bwd(scale, block_q, block_k, interpret, res, cts):
+def _flash_lse_core_bwd(scale, block_q, block_k, interpret, causal, res, cts):
     do, dlse = cts
-    return _flash_bwd_impl(scale, block_q, block_k, interpret, res, do, dlse)
+    return _flash_bwd_impl(scale, block_q, block_k, interpret, causal, res, do, dlse)
 
 
 _flash_lse_core.defvjp(_flash_lse_core_fwd, _flash_lse_core_bwd)
@@ -396,19 +422,23 @@ def flash_attention_lse(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
+    causal: bool = True,
 ):
-    """Causal flash self-attention returning (out, lse) — the per-query
-    logsumexp lets callers merge this block's result with other attention
-    partials (the ring-attention diagonal block, flash-decoding-style
-    two-level softmax reductions).  Fully differentiable in both outputs
-    (the lse cotangent folds into the same backward kernels)."""
+    """Flash attention returning (out, lse) — the per-query logsumexp lets
+    callers merge this block's result with other attention partials (the
+    ring-attention blocks, flash-decoding-style two-level softmax
+    reductions).  `causal=False` attends the whole K chunk (the ring's
+    off-diagonal blocks, where every key precedes every query).  Fully
+    differentiable in both outputs (the lse cotangent folds into the same
+    backward kernels)."""
     B, H, T, hs = q.shape
     if T != k.shape[2]:
         raise ValueError("flash path is self-attention over one chunk")
     if scale is None:
         scale = 1.0 / (hs**0.5)
     return _flash_lse_core(
-        float(scale), int(block_q), int(block_k), bool(interpret), q, k, v
+        float(scale), int(block_q), int(block_k), bool(interpret), bool(causal),
+        q, k, v,
     )
 
 
@@ -432,4 +462,6 @@ def flash_attention(
         raise ValueError("flash path is self-attention over one chunk")
     if scale is None:
         scale = 1.0 / (hs**0.5)
-    return _flash_core(float(scale), int(block_q), int(block_k), bool(interpret), q, k, v)
+    return _flash_core(
+        float(scale), int(block_q), int(block_k), bool(interpret), True, q, k, v
+    )

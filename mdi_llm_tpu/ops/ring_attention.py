@@ -42,13 +42,17 @@ def ring_attention(
     """Returns (B, n_head, Tq_local, hs) — attention of the local queries
     over the ENTIRE (distributed) key/value sequence.
 
-    `use_flash` runs the DIAGONAL block (each device's own chunk — the only
-    causally-masked (Tq, Tq) block) through the Pallas flash kernel
-    (ops/flash.flash_attention_lse) and seeds the online-softmax carry from
-    its (out, lse); the remaining P-1 ring hops merge as before.  Caller
-    contract: causal=True and q_pos == k_pos == contiguous per-device
-    ranges (the sp training/prefill geometry).  Differentiable — the lse
-    carries its own cotangent into the FA-2 backward kernels."""
+    `use_flash` runs EVERY block through the Pallas flash kernel
+    (ops/flash.flash_attention_lse): the diagonal block (own chunk) as
+    causal self-attention seeding the online-softmax carry from its
+    (out, lse), and each rotated chunk as an unmasked (causal=False) block
+    gated per batch row by whether the chunk precedes the local queries —
+    valid because ring chunks are contiguous disjoint position ranges, so
+    a rotated chunk is entirely before or entirely after the local
+    queries, never interleaved.  Caller contract: causal=True and
+    q_pos == k_pos == contiguous per-device ranges (the sp
+    training/prefill geometry).  Differentiable — each lse carries its own
+    cotangent into the FA-2 backward kernels."""
     B, n_head, Tq, hs = q.shape
     _, n_groups, Tk, _ = k.shape
     if scale is None:
@@ -106,14 +110,45 @@ def ring_attention(
         kp_n = jax.lax.ppermute(kp_c, axis_name, perm)
         return (k_n, v_n, kp_n, m_new, l, o), None
 
+    def flash_body(carry, _):
+        from mdi_llm_tpu.ops.flash import flash_attention_lse
+
+        k_c, v_c, kp_c, m, l, o = carry
+        # unmasked flash over the rotated chunk, then a two-way normalized
+        # merge; gate per batch row on "this chunk precedes every local
+        # query" (chunks are disjoint contiguous ranges, so all-or-nothing)
+        o_h, lse_h = flash_attention_lse(
+            q, k_c, v_c, scale=scale, interpret=flash_interpret, causal=False
+        )
+        gate = jnp.max(kp_c, axis=1) <= jnp.min(q_pos, axis=1)  # (B,)
+        gate4 = gate[:, None, None, None]
+        lse_hg = jnp.where(
+            gate4, lse_h.reshape(B, n_groups, q_per_kv, Tq), NEG_INF
+        )
+        m_new = jnp.maximum(m, lse_hg)
+        alpha = jnp.exp(jnp.maximum(m - m_new, -80.0))
+        # the chunk arrives normalized: its (m, l, o) form is (lse, 1, o_h)
+        beta = jnp.exp(jnp.maximum(lse_hg - m_new, -80.0)) * gate4.astype(
+            jnp.float32
+        )
+        l = l * alpha + beta
+        o = o * alpha[..., None] + (
+            o_h.reshape(B, n_groups, q_per_kv, Tq, hs).astype(jnp.float32)
+            * beta[..., None]
+        )
+        k_n = jax.lax.ppermute(k_c, axis_name, perm)
+        v_n = jax.lax.ppermute(v_c, axis_name, perm)
+        kp_n = jax.lax.ppermute(kp_c, axis_name, perm)
+        return (k_n, v_n, kp_n, m_new, l, o), None
+
     if use_flash and causal:
         # the diagonal block is already in the carry: start from the
-        # neighbors' chunks and walk the remaining P-1 hops
+        # neighbors' chunks and walk the remaining P-1 hops fully fused
         k1 = jax.lax.ppermute(k, axis_name, perm)
         v1 = jax.lax.ppermute(v, axis_name, perm)
         kp1 = jax.lax.ppermute(k_pos, axis_name, perm)
         (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
-            body, (k1, v1, kp1, m0, l0, o0), None, length=P - 1
+            flash_body, (k1, v1, kp1, m0, l0, o0), None, length=P - 1
         )
     else:
         (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
