@@ -191,7 +191,10 @@ def attention_forward(
 
             k_cache = jax.vmap(upd0)(k_cache, k)
             v_cache = jax.vmap(upd0)(v_cache, v)
-            y = ring_attention(q, k, v, pos, pos, sp_axis)
+            # sp prefill shares the ring-flash contract (q_pos == k_pos ==
+            # contiguous per-device chunk); padded bucket positions sit
+            # after the prompt so causal masking keeps them invisible
+            y = ring_attention(q, k, v, pos, pos, sp_axis, use_flash=use_flash)
         else:
             # sp decode: only the owning device appends the token's K/V at
             # cache_off.  The update itself is unconditional (in-place on the

@@ -68,6 +68,12 @@ class SPGenerator:
         cache_dtype=None,
         rng_seed: int = 1337,
         decode_chunk: int = 32,
+        use_flash: Optional[bool] = None,  # run prefill's ring attention
+        # through the Pallas flash kernel; None → auto (TPU backend), same
+        # convention as Generator
+        flash_min_len: int = 2048,  # engage flash only when the LOCAL
+        # sequence chunk is at least this long (v5e measurement in
+        # generation.py: XLA's fused attention wins below ~2k)
     ):
         if mesh is None:
             mesh = make_mesh(
@@ -81,6 +87,10 @@ class SPGenerator:
             cache_dtype = transformer.param_dtype(params)
         self.cache_dtype = cache_dtype
         self.decode_chunk = int(decode_chunk)
+        if use_flash is None:
+            use_flash = jax.default_backend() == "tpu"
+        self.use_flash = bool(use_flash)
+        self.flash_min_len = int(flash_min_len)
         self.key = jax.random.PRNGKey(rng_seed)
         repl = NamedSharding(mesh, P())
         self.params = jax.device_put(params, repl)
@@ -127,6 +137,9 @@ class SPGenerator:
                 logits, kv = transformer.forward(
                     cfg, params, toks, input_pos, kv=kv, rope=rope,
                     sp_axis="sp", sp_meta=(kp, jnp.int32(0), jnp.bool_(False)),
+                    # gate on the LOCAL chunk length: that's the tile the
+                    # kernel actually sees under sequence sharding
+                    use_flash=self.use_flash and Tl >= self.flash_min_len,
                 )
                 # gather each sample's last-prompt-token logits to all devices
                 own = (lens - 1) // Tl == d  # (B,)

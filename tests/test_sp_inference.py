@@ -115,6 +115,36 @@ def test_sp_mixed_length_batch(model, devices):
     assert got == want
 
 
+def test_sp_prefill_use_flash_traces_kernel(model, devices):
+    """SPGenerator(use_flash=True) routes ring prefill through the Pallas
+    kernel once the LOCAL chunk clears flash_min_len (trace-level check;
+    execution needs a TPU); short chunks stay on the XLA path; the None
+    default auto-resolves from the backend (off on the CPU test backend)."""
+    cfg, params = model
+
+    def trace(sp, Tl):
+        B, C = 1, Tl + 4
+        prefill = sp._get_prefill(B, Tl, C, 0.0, None, None)
+        toks = jnp.zeros((B, Tl * 2), jnp.int32)
+        kv = sp._init_kv(B, C)
+        return str(jax.make_jaxpr(
+            lambda p, r, t, l, kv_, k_: prefill(p, r, t, l, kv_, k_)
+        )(sp.params, sp.rope, toks, jnp.asarray([3], jnp.int32), kv,
+          jax.random.PRNGKey(0)))
+
+    sp = SPGenerator(
+        cfg, params, devices=devices[:2], cache_dtype=jnp.float32,
+        use_flash=True, flash_min_len=8,
+    )
+    assert "pallas_call" in trace(sp, 8)
+    # same engine, chunk below the gate → XLA path
+    assert "pallas_call" not in trace(sp, 4)
+    # auto default resolves from the backend (CPU here → off)
+    assert SPGenerator(
+        cfg, params, devices=devices[:2], cache_dtype=jnp.float32
+    ).use_flash is False
+
+
 def test_sp_gqa_variant(devices):
     cfg = tiny_config(block_size=128, n_layer=3, **CONFIG_VARIANTS["gqa"])
     params = init_params(cfg, jax.random.PRNGKey(6))
