@@ -59,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
         "prefill + sequence-sharded KV cache (context scales with N)",
     )
     ap.add_argument(
+        "--sp-flash",
+        action="store_true",
+        help="run the sp prefill ring through the Pallas flash kernel "
+        "(TPU opt-in; engages when the local chunk is >= 2048)",
+    )
+    ap.add_argument(
         "--tp-devices",
         type=int,
         default=0,
@@ -147,6 +153,7 @@ def main(argv=None):
             engine = SPGenerator(
                 cfg, params, n_devices=args.sp_devices, max_seq_length=seq_len,
                 rng_seed=args.seed, cache_dtype=resolve_kv_dtype(args.kv_dtype),
+                use_flash=args.sp_flash,
             )
             n_nodes = args.sp_devices
             outs, stats = engine.generate(

@@ -122,6 +122,28 @@ def ngram_draft(tokens: Sequence[int], k: int, ngram: int = 3) -> List[int]:
     return []
 
 
+def _place_ep_quantized(params, mesh: Mesh):
+    """Place a (possibly quantized) MoE tree on an ep(+dp) mesh: every >=2-D
+    leaf under an "experts" subtree shards axis 1 (the expert axis, after
+    the stacked-layer axis) over "ep"; every other leaf replicates.  Works
+    by position rather than leaf name, so weight_q/scale/weight_q4 layouts
+    need no dedicated spec table."""
+
+    def walk(node, in_experts):
+        if isinstance(node, dict):
+            return {
+                k: walk(v, in_experts or k == "experts") for k, v in node.items()
+            }
+        nd = np.ndim(node)
+        if in_experts and nd >= 2:
+            spec = P(None, "ep", *([None] * (nd - 2)))
+        else:
+            spec = P(*([None] * nd))
+        return jax.device_put(node, NamedSharding(mesh, spec))
+
+    return walk(params, False)
+
+
 def _bucket(n: int, minimum: int = 16) -> int:
     b = minimum
     while b < n:
@@ -200,26 +222,17 @@ class Generator:
         self._moe_impl = None
         if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
             raise ValueError(f"unknown quantize mode {quantize!r}")
-        if mesh is not None:
-            from mdi_llm_tpu.ops.quant import tree_has_quantized
-
-            # structural check, not just the flag: a pre-quantized
-            # checkpoint (prepare_model --quantize) loads with
-            # quantize='none' but its tree still has weight_q/scale leaves
-            if quantize not in (None, "none") or tree_has_quantized(params):
-                raise ValueError(
-                    "quantized trees use custom leaf names the GSPMD sharding "
-                    "rules don't cover; drop the mesh/tp or the quantization"
-                )
         if quantize in FLAG_TO_MODE:
             from mdi_llm_tpu.ops.quant import quantize_params
 
             # quantization happens host-side (numpy); pin the tree on device
-            # or every jit call re-uploads the whole model
-            params = jax.device_put(
-                quantize_params(params, mode=FLAG_TO_MODE[quantize])
-            )
+            # or every jit call re-uploads the whole model (under a mesh the
+            # sharded placement below does the pinning)
+            params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
+            if mesh is None:
+                params = jax.device_put(params)
         if mesh is not None:
+            from mdi_llm_tpu.ops.quant import tree_has_quantized
             from mdi_llm_tpu.parallel.sharding import (
                 shard_params,
                 validate_tp_divisibility,
@@ -228,10 +241,25 @@ class Generator:
             tp_n = int(mesh.shape.get("tp", 1))
             dp_n = int(mesh.shape.get("dp", 1))
             ep_n = int(mesh.shape.get("ep", 1))
+            # structural check, not just the flag: a pre-quantized
+            # checkpoint (prepare_model --quantize) loads with
+            # quantize='none' but its tree still has weight_q/scale leaves
+            quantized = quantize in FLAG_TO_MODE or tree_has_quantized(params)
+            ep_moe = ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE"
+            if quantized and (tp_n > 1 or not ep_moe):
+                # ep-only (± dp) quantized MoE is supported below: experts
+                # shard by their leading axis regardless of leaf names, and
+                # everything else replicates.  tp sharding would need
+                # quantized-aware Megatron specs, which don't exist.
+                raise ValueError(
+                    "quantized trees use custom leaf names the GSPMD sharding "
+                    "rules don't cover; drop the mesh/tp or the quantization "
+                    "(expert-parallel MoE meshes are the exception)"
+                )
             # vocab counts here: the Generator tp-shards embeddings/head
             validate_tp_divisibility(cfg, tp_n, check_vocab=True)
             ep_axis = None
-            if ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE":
+            if ep_moe:
                 if cfg.n_expert % ep_n:
                     raise ValueError(
                         f"ep={ep_n} does not divide n_expert={cfg.n_expert}"
@@ -245,9 +273,15 @@ class Generator:
                     axis="ep",
                     capacity_factor=moe_capacity_factor,
                 )
-            params = shard_params(
-                params, cfg, mesh, "tp" if tp_n > 1 else None, ep_axis
-            )
+            if quantized:
+                # name-agnostic placement: leaves under an "experts" subtree
+                # shard their (layer, expert, ...) expert axis over ep (this
+                # covers weight_q/scale layouts too); all else replicates
+                params = _place_ep_quantized(params, mesh)
+            else:
+                params = shard_params(
+                    params, cfg, mesh, "tp" if tp_n > 1 else None, ep_axis
+                )
             self._dp = dp_n
             # KV cache (L, B, G, S, hs): batch on dp, KV groups on tp
             self._kv_sharding = NamedSharding(

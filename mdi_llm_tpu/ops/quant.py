@@ -236,6 +236,18 @@ def init_quantized_params(cfg, seed: int = 0, mode: str = "w8", dtype=None):
     return params
 
 
+def _apply_scale(spec: str, y: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Multiply `y` (the quantized contraction's result) by the per-channel
+    scale, aligned by einsum label rather than trailing-axis broadcasting:
+    the scale's dims are the weight subscripts minus the contracted last
+    one, which need not be trailing in the output (the expert-parallel
+    dispatch uses "emd,eid->emi", where scale (e, i) straddles m)."""
+    xin, out = spec.split("->")
+    _, w_sub = xin.split(",")
+    kept = w_sub[:-1]  # quantize scale shape == weight dims minus the last
+    return jnp.einsum(f"{out},{kept}->{out}", y, scale)
+
+
 def quantized_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     """einsum against a (possibly) quantized weight dict.  `spec` contracts
     x with the stored (out, in)-layout weight; the per-out-channel scale is
@@ -253,10 +265,12 @@ def quantized_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:
         # (1 for plain linears, 2 for the expert einsums)
         extra = y.ndim - (x.ndim - 1)
         xs = xs.reshape(xs.shape[:-1] + (1,) * max(extra, 1))
-        return (y.astype(jnp.float32) * xs * p["scale"]).astype(x.dtype)
+        return _apply_scale(spec, y.astype(jnp.float32) * xs, p["scale"]).astype(
+            x.dtype
+        )
     if "weight_q" in p:
         y = jnp.einsum(spec, x, p["weight_q"].astype(x.dtype))
-        return y * p["scale"].astype(x.dtype)
+        return _apply_scale(spec, y, p["scale"].astype(x.dtype))
     if "weight_q4" in p:
         return _w4_einsum(spec, x, p)
     return jnp.einsum(spec, x, p["weight"])

@@ -68,9 +68,11 @@ class SPGenerator:
         cache_dtype=None,
         rng_seed: int = 1337,
         decode_chunk: int = 32,
-        use_flash: Optional[bool] = None,  # run prefill's ring attention
-        # through the Pallas flash kernel; None → auto (TPU backend), same
-        # convention as Generator
+        use_flash: bool = False,  # run prefill's ring attention through
+        # the Pallas flash kernel.  Explicit opt-in (not auto): the fused
+        # sp ring is interpret/trace-tested but has not yet executed on
+        # real TPU hardware — same reasoning as Trainer's sp opt-in.
+        # Flip to an auto default once a TPU run validates it.
         flash_min_len: int = 2048,  # engage flash only when the LOCAL
         # sequence chunk is at least this long (v5e measurement in
         # generation.py: XLA's fused attention wins below ~2k)
@@ -87,8 +89,6 @@ class SPGenerator:
             cache_dtype = transformer.param_dtype(params)
         self.cache_dtype = cache_dtype
         self.decode_chunk = int(decode_chunk)
-        if use_flash is None:
-            use_flash = jax.default_backend() == "tpu"
         self.use_flash = bool(use_flash)
         self.flash_min_len = int(flash_min_len)
         self.key = jax.random.PRNGKey(rng_seed)

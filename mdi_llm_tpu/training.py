@@ -194,7 +194,12 @@ class Trainer:
         # time rather than changing defaults.
         sp_mesh = mesh is not None and "sp" in mesh.axis_names
         self.use_flash = (
-            jax.default_backend() == "tpu" and mesh is None
+            jax.default_backend() == "tpu"
+            and mesh is None
+            # v5e measurement (generation.py): XLA's fused attention wins
+            # below ~2k, so short-context training stays on the XLA path
+            # unless explicitly forced
+            and self.block_size >= 2048
             if tc.use_flash is None
             else tc.use_flash
         )

@@ -118,8 +118,8 @@ def test_sp_mixed_length_batch(model, devices):
 def test_sp_prefill_use_flash_traces_kernel(model, devices):
     """SPGenerator(use_flash=True) routes ring prefill through the Pallas
     kernel once the LOCAL chunk clears flash_min_len (trace-level check;
-    execution needs a TPU); short chunks stay on the XLA path; the None
-    default auto-resolves from the backend (off on the CPU test backend)."""
+    execution needs a TPU); short chunks stay on the XLA path; the default
+    is off (explicit opt-in until validated on hardware)."""
     cfg, params = model
 
     def trace(sp, Tl):
@@ -139,7 +139,7 @@ def test_sp_prefill_use_flash_traces_kernel(model, devices):
     assert "pallas_call" in trace(sp, 8)
     # same engine, chunk below the gate → XLA path
     assert "pallas_call" not in trace(sp, 4)
-    # auto default resolves from the backend (CPU here → off)
+    # default stays off (opt-in until a real-TPU run validates the path)
     assert SPGenerator(
         cfg, params, devices=devices[:2], cache_dtype=jnp.float32
     ).use_flash is False
