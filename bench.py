@@ -493,7 +493,8 @@ def run_suite(args):
             for extra in attempts:
                 cfg_flags = row["flags"] + extra
                 res, err = _child(cfg_flags, timeout=row["timeout"])
-                if res is None and err and err.startswith("backend"):
+                if (res is None and err and err.startswith("backend")
+                        and elapsed() <= args.suite_budget):
                     # backend dropped mid-suite: sleep and retry the SAME
                     # config in a fresh interpreter before degrading to the
                     # next ladder rung — a transient init failure must not

@@ -222,29 +222,18 @@ class Generator:
         self._moe_impl = None
         if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
             raise ValueError(f"unknown quantize mode {quantize!r}")
-        if quantize in FLAG_TO_MODE:
-            from mdi_llm_tpu.ops.quant import quantize_params
-
-            # quantization happens host-side (numpy); pin the tree on device
-            # or every jit call re-uploads the whole model (under a mesh the
-            # sharded placement below does the pinning)
-            params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
-            if mesh is None:
-                params = jax.device_put(params)
+        quantized = quantize in FLAG_TO_MODE
         if mesh is not None:
             from mdi_llm_tpu.ops.quant import tree_has_quantized
-            from mdi_llm_tpu.parallel.sharding import (
-                shard_params,
-                validate_tp_divisibility,
-            )
 
-            tp_n = int(mesh.shape.get("tp", 1))
-            dp_n = int(mesh.shape.get("dp", 1))
-            ep_n = int(mesh.shape.get("ep", 1))
-            # structural check, not just the flag: a pre-quantized
+            # guard BEFORE the (possibly minutes-long) host-side
+            # quantization of a large tree: it only needs mesh.shape + cfg.
+            # Structural check, not just the flag: a pre-quantized
             # checkpoint (prepare_model --quantize) loads with
             # quantize='none' but its tree still has weight_q/scale leaves
-            quantized = quantize in FLAG_TO_MODE or tree_has_quantized(params)
+            quantized = quantized or tree_has_quantized(params)
+            tp_n = int(mesh.shape.get("tp", 1))
+            ep_n = int(mesh.shape.get("ep", 1))
             ep_moe = ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE"
             if quantized and (tp_n > 1 or not ep_moe):
                 # ep-only (± dp) quantized MoE is supported below: experts
@@ -256,6 +245,25 @@ class Generator:
                     "rules don't cover; drop the mesh/tp or the quantization "
                     "(expert-parallel MoE meshes are the exception)"
                 )
+        if quantize in FLAG_TO_MODE:
+            from mdi_llm_tpu.ops.quant import quantize_params
+
+            # quantization happens host-side (numpy); pin the tree on device
+            # or every jit call re-uploads the whole model (under a mesh the
+            # sharded placement below does the pinning)
+            params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
+            if mesh is None:
+                params = jax.device_put(params)
+        if mesh is not None:
+            from mdi_llm_tpu.parallel.sharding import (
+                shard_params,
+                validate_tp_divisibility,
+            )
+
+            tp_n = int(mesh.shape.get("tp", 1))
+            dp_n = int(mesh.shape.get("dp", 1))
+            ep_n = int(mesh.shape.get("ep", 1))
+            ep_moe = ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE"
             # vocab counts here: the Generator tp-shards embeddings/head
             validate_tp_divisibility(cfg, tp_n, check_vocab=True)
             ep_axis = None

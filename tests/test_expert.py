@@ -164,28 +164,32 @@ def test_full_forward_with_moe_impl(devices):
     )
 
 
-def test_generator_ep_quantized_decode_parity(devices):
-    """int8-quantized MoE decode over an ep mesh (Mixtral-int8 serving
-    shape) equals single-device quantized decode: the name-agnostic expert
-    placement + quantized_einsum dispatch inside the shard_map."""
+@pytest.mark.parametrize("mode,wkey", [
+    ("int8", "weight_q"), ("w8a8", "weight_q8"), ("int4", "weight_q4"),
+])
+def test_generator_ep_quantized_decode_parity(devices, mode, wkey):
+    """Quantized MoE decode over an ep mesh (Mixtral-int8/int4 serving
+    shapes) equals single-device quantized decode: the name-agnostic expert
+    placement + quantized_einsum dispatch inside the shard_map, for every
+    storage mode the Generator guard admits over ep."""
     from mdi_llm_tpu.generation import Generator
 
     cfg = moe_config()
     params = init_params(cfg, jax.random.PRNGKey(2))
     prompts = [[3, 7, 11, 2], [5, 1, 9, 13, 4]]
 
-    ref, _ = Generator(cfg, params, max_seq_length=64, quantize="int8").generate(
+    ref, _ = Generator(cfg, params, max_seq_length=64, quantize=mode).generate(
         prompts, 10, temperature=0.0
     )
     mesh = make_mesh({"ep": 4}, jax.devices()[:4])
     eng = Generator(
-        cfg, params, max_seq_length=64, quantize="int8", mesh=mesh
+        cfg, params, max_seq_length=64, quantize=mode, mesh=mesh
     )
     assert eng._moe_impl is not None
     got, _ = eng.generate(prompts, 10, temperature=0.0)
     assert got == ref
     # expert leaves really are sharded over ep (not replicated)
-    wq = eng.params["blocks"]["mlp"]["experts"]["fc_1"]["weight_q"]
+    wq = eng.params["blocks"]["mlp"]["experts"]["fc_1"][wkey]
     assert "ep" in str(wq.sharding.spec)
 
 
