@@ -472,8 +472,8 @@ def run_suite(args):
             # behind the wedge — wait once more then give up on TPU
             if attempt >= 1:
                 break
-        if elapsed() > args.suite_budget / 3:
-            break
+        if elapsed() > args.suite_budget / 3 or attempt == 3:
+            break  # no sleep after the final attempt: go straight to fallback
         time.sleep(60)
 
     selected = None if not args.rows else set(args.rows.split(","))

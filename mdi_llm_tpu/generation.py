@@ -223,6 +223,11 @@ class Generator:
         if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
             raise ValueError(f"unknown quantize mode {quantize!r}")
         quantized = quantize in FLAG_TO_MODE
+        # mesh-derived axis sizes, shared by the guard and sharding blocks
+        tp_n = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        dp_n = int(mesh.shape.get("dp", 1)) if mesh is not None else 1
+        ep_n = int(mesh.shape.get("ep", 1)) if mesh is not None else 1
+        ep_moe = ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE"
         if mesh is not None:
             from mdi_llm_tpu.ops.quant import tree_has_quantized
 
@@ -232,9 +237,6 @@ class Generator:
             # checkpoint (prepare_model --quantize) loads with
             # quantize='none' but its tree still has weight_q/scale leaves
             quantized = quantized or tree_has_quantized(params)
-            tp_n = int(mesh.shape.get("tp", 1))
-            ep_n = int(mesh.shape.get("ep", 1))
-            ep_moe = ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE"
             if quantized and (tp_n > 1 or not ep_moe):
                 # ep-only (± dp) quantized MoE is supported below: experts
                 # shard by their leading axis regardless of leaf names, and
@@ -259,11 +261,6 @@ class Generator:
                 shard_params,
                 validate_tp_divisibility,
             )
-
-            tp_n = int(mesh.shape.get("tp", 1))
-            dp_n = int(mesh.shape.get("dp", 1))
-            ep_n = int(mesh.shape.get("ep", 1))
-            ep_moe = ep_n > 1 and cfg.mlp_class_name == "LLaMAMoE"
             # vocab counts here: the Generator tp-shards embeddings/head
             validate_tp_divisibility(cfg, tp_n, check_vocab=True)
             ep_axis = None

@@ -1,0 +1,169 @@
+"""bench.py suite-mode logic, offline: the orchestrator must be un-losable
+(emit a headline JSON whatever the backend does).  Children are simulated
+by monkeypatching bench._child, so these tests cover the scheduling /
+retry / fallback / assembly logic without any device.
+"""
+
+import json
+import types
+
+import pytest
+
+import bench
+
+
+def _args(**kw):
+    ns = types.SimpleNamespace(
+        suite_budget=kw.pop("suite_budget", 600.0),
+        rows=kw.pop("rows", None),
+        probe_timeout=kw.pop("probe_timeout", 5.0),
+    )
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def _probe_ok():
+    return {"metric": "backend probe", "value": 1.0, "unit": "s",
+            "vs_baseline": 1.0,
+            "detail": {"backend": "tpu", "device": "TPU v5 lite0"}}
+
+
+def _row(value, model="tiny-llama-1.1b", vs=None):
+    return {
+        "metric": f"decode tokens/sec/chip ({model})",
+        "value": value, "unit": "tokens/s/chip",
+        "vs_baseline": vs if vs is not None else round(value / 7.0, 2),
+        "detail": {"config": {"model": model}},
+    }
+
+
+def run_suite_with(monkeypatch, child_fn, **args_kw):
+    monkeypatch.setattr(bench, "_child", child_fn)
+    monkeypatch.setattr(bench.time, "sleep", lambda *_: None)
+    return bench.run_suite(_args(**args_kw))
+
+
+def test_happy_path_all_rows(monkeypatch):
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            return _probe_ok(), None
+        if "Llama-3-8B-Instruct" in argv:
+            return _row(500.0, "Llama-3-8B-Instruct", vs=12.5), None
+        return _row(2700.0), None
+
+    out = run_suite_with(monkeypatch, child)
+    assert out["value"] == 2700.0
+    assert out["detail"]["north_star"]["met"] is True
+    assert out["detail"]["north_star"]["vs_jetson_8b"] == 12.5
+    assert set(out["detail"]["rows"]) == {r["name"] for r in bench.SUITE_ROWS}
+    json.dumps(out)  # the artifact must be serializable
+
+
+def _effective_batch(argv):
+    """argparse semantics: the LAST --batch occurrence wins."""
+    idx = max(i for i, a in enumerate(argv) if a == "--batch")
+    return argv[idx + 1]
+
+
+def test_ladder_walks_down_on_error(monkeypatch):
+    tried = []
+
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            return _probe_ok(), None
+        b = _effective_batch(argv)
+        tried.append(b)
+        if b == "24":  # headline config OOMs; the ladder rung succeeds
+            return None, "error: RESOURCE_EXHAUSTED"
+        return _row(2283.0), None
+
+    out = run_suite_with(monkeypatch, child, rows="tinyllama-bf16")
+    row = out["detail"]["rows"]["tinyllama-bf16"]
+    assert "error" not in row
+    assert row["value"] == 2283.0
+    assert tried == ["24", "16"]  # walked exactly one rung down
+
+
+def test_backend_drop_retries_same_config_first(monkeypatch):
+    seen = []
+
+    def child(argv, timeout, env=None):
+        flat = " ".join(argv)
+        if "--probe" in argv:
+            return _probe_ok(), None
+        seen.append(flat)
+        # first attempt at the intended config drops; the retry succeeds
+        if len(seen) == 1:
+            return None, "backend: Unable to initialize backend 'axon'"
+        return _row(2700.0), None
+
+    out = run_suite_with(monkeypatch, child, rows="tinyllama-bf16")
+    assert out["value"] == 2700.0
+    # the retry reran the SAME flags rather than degrading the ladder
+    assert seen[0] == seen[1]
+    assert "--batch 24" in seen[1]
+
+
+def test_timeout_marks_wedged_and_skips_rest(monkeypatch):
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            return _probe_ok(), None
+        if "Llama-3-8B-Instruct" in " ".join(argv):
+            return None, "timeout"
+        return _row(2700.0), None
+
+    out = run_suite_with(monkeypatch, child)
+    rows = out["detail"]["rows"]
+    assert out["value"] == 2700.0  # the already-banked headline survives
+    assert "wedged" in rows["llama3-8b-int8"]["error"]
+    # everything after the wedge is skipped, not attempted
+    assert rows["ring-pipeline-m16"]["error"].startswith("skipped")
+    assert rows["llama3-8b-int4"]["error"].startswith("skipped")
+    assert out["detail"]["north_star"]["met"] is False
+
+
+def test_tpu_never_up_falls_back_to_cpu(monkeypatch):
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            return None, "timeout"
+        assert "--backend" in argv and "cpu" in argv
+        return _row(0.7), None
+
+    out = run_suite_with(monkeypatch, child)
+    assert out["value"] == 0.7
+    assert "cpu-fallback" in " ".join(out["detail"]["rows"]).lower() or \
+        "tinyllama-bf16-cpu-fallback" in out["detail"]["rows"]
+
+
+def test_north_star_picks_better_8b_row(monkeypatch):
+    def child(argv, timeout, env=None):
+        flat = " ".join(argv)
+        if "--probe" in argv:
+            return _probe_ok(), None
+        if "int8" in flat and "Llama" in flat:
+            return _row(40.0, "Llama-3-8B-Instruct", vs=1.0), None
+        if "int4" in flat and "Llama" in flat:
+            return _row(80.0, "Llama-3-8B-Instruct", vs=2.0), None
+        return _row(2700.0), None
+
+    out = run_suite_with(monkeypatch, child)
+    ns = out["detail"]["north_star"]
+    assert ns["met"] is True and ns["vs_jetson_8b"] == 2.0
+
+
+def test_everything_fails_still_emits(monkeypatch):
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            return _probe_ok(), None
+        return None, "error: boom"
+
+    out = run_suite_with(monkeypatch, child)
+    assert out["unit"] == "tokens/s/chip"
+    assert out["value"] == 0.0
+    json.dumps(out)
+
+
+def test_baseline_for_routes_by_model():
+    assert bench.baseline_for("Llama-3-8B-Instruct") == bench.JETSON_8B_TOKENS_PER_S
+    assert bench.baseline_for("tiny-llama-1.1b") == bench.REFERENCE_TOKENS_PER_S
