@@ -98,6 +98,9 @@ def build_parser():
     )
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="direct mode: wrap the timed run in a jax.profiler trace")
+    ap.add_argument("--scan-unroll", type=int, default=1,
+                    help="layer-scan unroll factor (single-chip engine): "
+                    "trades compile time for per-layer loop overhead")
     return ap
 
 
@@ -304,11 +307,11 @@ def run_decode(args):
 
         engine = Generator(
             cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
-            quantize=quantize,
+            quantize=quantize, scan_unroll=args.scan_unroll,
         )
         label = "batched-decode" + (
             f"+{args.quantize}" if args.quantize != "none" else ""
-        )
+        ) + (f"+unroll{args.scan_unroll}" if args.scan_unroll != 1 else "")
 
     kwargs = {} if args.pipeline else {"chunk_size": args.chunk}
     # warmup with the run's own token budget: KV caches are sized to the run

@@ -214,6 +214,10 @@ class Generator:
         moe_capacity_factor: Optional[float] = None,  # None → exact (no
         # dropped assignments); a finite factor bounds the EP dispatch
         # buffers at the cost of Switch-style token drops
+        scan_unroll: int = 1,  # layer-scan unroll factor: decode steps are
+        # small, so XLA while-loop bookkeeping per layer is measurable;
+        # unrolling trades compile time for loop overhead (bench
+        # --scan-unroll to measure before changing the default)
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -309,6 +313,7 @@ class Generator:
         self.flash_min_len = int(flash_min_len)
         self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
         self.cache_dtype = cache_dtype
+        self.scan_unroll = int(scan_unroll)
         self.rope = transformer.get_rope_cache(cfg)
         self.key = jax.random.PRNGKey(rng_seed)
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
@@ -338,6 +343,9 @@ class Generator:
                     fresh_prefill=True,
                     # flash pays off on big tiles; small buckets stay on XLA
                     use_flash=self.use_flash and T >= self.flash_min_len,
+                    # no unroll here: prefill tiles are large enough that
+                    # loop bookkeeping is noise, and unrolled bodies
+                    # multiply compile time per prompt bucket
                     moe_impl=self._moe_impl,
                 )
                 last = jnp.take_along_axis(
@@ -355,7 +363,7 @@ class Generator:
             def decode(params, tokens, kv, input_pos, key, temperature, top_k, top_p):
                 logits, kv = transformer.forward(
                     self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope,
-                    moe_impl=self._moe_impl,
+                    moe_impl=self._moe_impl, unroll=self.scan_unroll,
                 )
                 key, sub = jax.random.split(key)
                 tok = sample(
@@ -383,7 +391,7 @@ class Generator:
                     tok, kv, pos, key = carry
                     logits, kv = transformer.forward(
                         self.cfg, params, tok[:, None], pos, kv=kv, rope=self.rope,
-                        moe_impl=self._moe_impl,
+                        moe_impl=self._moe_impl, unroll=self.scan_unroll,
                     )
                     key, sub = jax.random.split(key)
                     nxt = sample(

@@ -303,11 +303,14 @@ def run_blocks(
     use_flash: bool = False,
     sp_meta: Optional[Tuple] = None,
     moe_impl=None,
+    unroll: int = 1,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
     rematerializes each block under autodiff (training memory ∝ 1 layer's
     activations instead of L — the TPU substitute for the reference's AMP
-    memory savings, SURVEY.md §2.4)."""
+    memory savings, SURVEY.md §2.4).  `unroll` trades compile time for
+    per-iteration loop overhead (decode steps are small enough that the
+    XLA while-loop bookkeeping is a measurable slice of each layer)."""
 
     if kv is None:
 
@@ -320,7 +323,7 @@ def run_blocks(
 
         if remat:
             body = jax.checkpoint(body)
-        x, _ = jax.lax.scan(body, x, blocks)
+        x, _ = jax.lax.scan(body, x, blocks, unroll=unroll)
         return x, None
 
     def body(carry, xs):
@@ -332,7 +335,9 @@ def run_blocks(
         )
         return y, (k_c, v_c)
 
-    x, (k_new, v_new) = jax.lax.scan(body, x, (blocks, kv["k"], kv["v"]))
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (blocks, kv["k"], kv["v"]), unroll=unroll
+    )
     return x, {"k": k_new, "v": v_new}
 
 
@@ -375,6 +380,7 @@ def forward(
     use_flash: bool = False,
     sp_meta: Optional[Tuple] = None,
     moe_impl=None,
+    unroll: int = 1,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
 
@@ -400,7 +406,7 @@ def forward(
     x, kv = run_blocks(
         cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat,
         sp_axis=sp_axis, fresh_prefill=fresh_prefill, use_flash=use_flash,
-        sp_meta=sp_meta, moe_impl=moe_impl,
+        sp_meta=sp_meta, moe_impl=moe_impl, unroll=unroll,
     )
     return head(cfg, params, x), kv
 

@@ -86,6 +86,19 @@ CONFIG_VARIANTS = {
 }
 
 
+def test_scan_unroll_parity():
+    """unroll > 1 is a pure scheduling change: logits must be identical."""
+    cfg = tiny_config(n_layer=4)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    toks = jnp.arange(8, dtype=jnp.int32).reshape(1, 8) % cfg.vocab_size
+    pos0 = jnp.zeros((1,), jnp.int32)
+    kv1 = init_kv_cache(cfg, 1, 32, dtype=jnp.float32)
+    kv2 = init_kv_cache(cfg, 1, 32, dtype=jnp.float32)
+    l1, _ = forward(cfg, params, toks, pos0, kv=kv1)
+    l2, _ = forward(cfg, params, toks, pos0, kv=kv2, unroll=2)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
 @pytest.mark.parametrize("variant", list(CONFIG_VARIANTS))
 def test_forward_shapes(variant):
     cfg = tiny_config(**CONFIG_VARIANTS[variant])
