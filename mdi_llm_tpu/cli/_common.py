@@ -55,6 +55,31 @@ def make_tp_mesh(tp_devices: int, quantize: str):
     return make_mesh({"tp": tp_devices}, jax.devices()[:tp_devices])
 
 
+def make_ep_mesh(ep_devices: int, cfg: Config):
+    """Shared --ep-devices handling: validate (MoE config, >=2 devices,
+    enough devices) and build a 1-D ep mesh over the first N devices."""
+    if ep_devices < 2:
+        raise SystemExit(
+            "--ep-devices needs at least 2 devices (expert dispatch over an "
+            "ep mesh; a single device is just the dense MoE path)"
+        )
+    if cfg.mlp_class_name != "LLaMAMoE":
+        raise SystemExit(
+            f"--ep-devices needs a MoE config; {cfg.name} has "
+            f"mlp_class_name={cfg.mlp_class_name}"
+        )
+    import jax
+
+    from mdi_llm_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < ep_devices:
+        raise SystemExit(
+            f"--ep-devices {ep_devices} exceeds the {len(jax.devices())} "
+            "available devices"
+        )
+    return make_mesh({"ep": ep_devices}, jax.devices()[:ep_devices])
+
+
 def add_common_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--ckpt", type=Path, default=None, help="checkpoint directory")
     ap.add_argument(

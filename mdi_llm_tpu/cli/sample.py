@@ -65,6 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(TPU opt-in; engages when the local chunk is >= 2048)",
     )
     ap.add_argument(
+        "--ep-devices",
+        type=int,
+        default=0,
+        help="expert-parallel inference over N>=2 devices (MoE configs "
+        "only): GShard token dispatch, experts sharded over the ep mesh "
+        "axis; composes with --quantize int8/w8a8/int4",
+    )
+    ap.add_argument(
         "--tp-devices",
         type=int,
         default=0,
@@ -130,6 +138,11 @@ def main(argv=None):
         raise SystemExit("--tp-devices is exclusive with --sp-devices")
     if args.tp_devices < 0:
         raise SystemExit("--tp-devices must be a positive device count")
+    if args.ep_devices and (args.tp_devices or args.sp_devices or args.pipeline_stages):
+        raise SystemExit(
+            "--ep-devices is a standalone expert-parallel mesh; drop the "
+            "other parallelism flags"
+        )
     if args.tp_devices > 1 and args.pipeline_stages and args.quantize not in (None, "none"):
         raise SystemExit("--quantize is not supported on a pipe x tp mesh yet")
     seq_len = args.sequence_length
@@ -187,6 +200,11 @@ def main(argv=None):
 
                 mesh = make_tp_mesh(args.tp_devices, args.quantize)
                 n_nodes = args.tp_devices
+            elif args.ep_devices:
+                from mdi_llm_tpu.cli._common import make_ep_mesh
+
+                mesh = make_ep_mesh(args.ep_devices, cfg)
+                n_nodes = args.ep_devices
             engine = Generator(
                 cfg, params, max_seq_length=seq_len, rng_seed=args.seed,
                 quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),

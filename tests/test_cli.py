@@ -213,6 +213,22 @@ def test_chat_cli_tp_mesh(tiny_ckpt, monkeypatch, capsys):
     assert "Chatting with" in capsys.readouterr().out
 
 
+def test_sample_cli_ep_devices_validation(tiny_ckpt):
+    """--ep-devices rejects non-MoE configs and other parallelism flags
+    (the happy path is pinned at the Generator level in test_expert.py)."""
+    from mdi_llm_tpu.cli.sample import main
+
+    with pytest.raises(SystemExit, match="MoE config"):
+        main(["--ckpt", str(tiny_ckpt), "--dtype", "float32",
+              "--ep-devices", "2", "--n-tokens", "2"])
+    with pytest.raises(SystemExit, match="standalone expert-parallel"):
+        main(["--ckpt", str(tiny_ckpt), "--dtype", "float32",
+              "--ep-devices", "2", "--tp-devices", "2", "--n-tokens", "2"])
+    with pytest.raises(SystemExit, match="at least 2 devices"):
+        main(["--ckpt", str(tiny_ckpt), "--dtype", "float32",
+              "--ep-devices", "-1", "--n-tokens", "2"])
+
+
 def test_chat_cli_pipeline_ring(tiny_ckpt, monkeypatch, capsys):
     """Streaming chat over a 2-stage recurrent pipeline ring (virtual CPU
     mesh): the reply must stream and match what the REPL records."""
