@@ -6,10 +6,11 @@ print correctly, chat.py:36-54), keep the conversation in the KV window by
 accumulating turn tokens, stop on the style's stop sequences.
 
 Streaming backends: single device (default), tensor-parallel
-(`--tp-devices N`), or the recurrent pipeline ring (`--pipeline-stages N`)
-— the last matching the reference's distributed chat experience where the
-starter surfaces tokens as they come back around the ring
-(gptserver.py:904-956).
+(`--tp-devices N`), expert-parallel for MoE configs (`--ep-devices N`,
+GShard token dispatch), or the recurrent pipeline ring
+(`--pipeline-stages N`) — the last matching the reference's distributed
+chat experience where the starter surfaces tokens as they come back
+around the ring (gptserver.py:904-956).
 """
 
 from __future__ import annotations
@@ -52,6 +53,13 @@ def build_parser():
         "stages; tokens surface as stage 0 collects them)",
     )
     ap.add_argument(
+        "--ep-devices",
+        type=int,
+        default=0,
+        help="expert-parallel streaming for MoE configs (N>=2 devices; "
+        "GShard token dispatch over an ep mesh)",
+    )
+    ap.add_argument(
         "--rotations-per-call",
         type=int,
         default=2,
@@ -65,10 +73,11 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     setup_logging(args)
     select_device(args)
-    if args.tp_devices and args.pipeline_stages:
+    if sum(bool(f) for f in (args.tp_devices, args.pipeline_stages, args.ep_devices)) > 1:
         raise SystemExit(
-            "--tp-devices and --pipeline-stages are separate streaming "
-            "backends; for a pipe x tp mesh use cli/starter.py"
+            "--tp-devices, --pipeline-stages and --ep-devices are separate "
+            "streaming backends; pick one (for a pipe x tp mesh use "
+            "cli/starter.py)"
         )
     cfg, params, tokenizer, prompt_style = load_model(args)
     if tokenizer is None:
@@ -94,6 +103,10 @@ def main(argv=None):
             from mdi_llm_tpu.cli._common import make_tp_mesh
 
             mesh = make_tp_mesh(args.tp_devices, args.quantize)
+        elif args.ep_devices:
+            from mdi_llm_tpu.cli._common import make_ep_mesh
+
+            mesh = make_ep_mesh(args.ep_devices, cfg)
         eng = Generator(
             cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed,
             quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),

@@ -92,6 +92,13 @@ class StopPrefixFilter:
             return
         self.seen.append(tok)
         if detect_stop_tokens(self.seen, self.stop_sequences):
+            # a shorter stop sequence may fire while longer-prefix tokens
+            # are still held back; release everything before the stop start
+            # so the stream matches the find_eot-trimmed result exactly
+            cut = find_eot(self.seen, self.stop_sequences)
+            while self.emitted < cut:
+                self.emit(self.seen[self.emitted])
+                self.emitted += 1
             self.stopped = True
             return
         while self.emitted < len(self.seen) - self.hold:

@@ -213,6 +213,38 @@ def test_chat_cli_tp_mesh(tiny_ckpt, monkeypatch, capsys):
     assert "Chatting with" in capsys.readouterr().out
 
 
+def test_stop_prefix_filter_unit():
+    """StopPrefixFilter invariants, directly: multi-token stops are never
+    emitted (not even partially), interleaved near-miss prefixes are
+    released once disambiguated, and flush() drains only stop-free tails."""
+    from mdi_llm_tpu.generation import StopPrefixFilter
+
+    def run(stops, tokens, flush=True):
+        out = []
+        f = StopPrefixFilter(stops, out.append)
+        for t in tokens:
+            f.push(t)
+        if flush:
+            f.flush()
+        return out, f.stopped
+
+    # full stop sequence suppressed entirely
+    out, stopped = run([[8, 9]], [1, 2, 8, 9, 3])
+    assert out == [1, 2] and stopped
+    # near-miss prefix (8 not followed by 9) is eventually released
+    out, stopped = run([[8, 9]], [1, 8, 2, 3])
+    assert out == [1, 8, 2, 3] and not stopped
+    # longest stop sets the hold-back; shorter stop still detected
+    out, stopped = run([[7], [8, 9]], [1, 2, 7])
+    assert out == [1, 2] and stopped
+    # no stops at all: everything streams immediately (hold == 0)
+    out, stopped = run([], [4, 5, 6], flush=False)
+    assert out == [4, 5, 6]
+    # tokens after the stop are ignored
+    out, stopped = run([[9]], [1, 9, 5, 6])
+    assert out == [1] and stopped
+
+
 def test_sample_cli_ep_devices_validation(tiny_ckpt):
     """--ep-devices rejects non-MoE configs and other parallelism flags
     (the happy path is pinned at the Generator level in test_expert.py)."""
