@@ -28,7 +28,7 @@ from mdi_llm_tpu.cli._common import (
     setup_logging,
 )
 from mdi_llm_tpu.config import TEMPERATURE, TOP_K
-from mdi_llm_tpu.generation import Generator, StopPrefixFilter
+from mdi_llm_tpu.generation import Generator, StreamPrinter
 
 
 def build_parser():
@@ -129,31 +129,12 @@ def main(argv=None):
         if len(context) > limit > 0:
             context = context[-limit:]  # slide the window
 
-        reply_ids: list[int] = []
-        printed = ""
-
-        def emit_tok(tok: int):
-            nonlocal printed
-            reply_ids.append(tok)
-            # incremental re-decode (≡ chat.py:174-200): print only the
-            # newly stabilized suffix
-            text = tokenizer.decode(np.asarray(reply_ids))
-            if text.startswith(printed):
-                sys.stdout.write(text[len(printed) :])
-                sys.stdout.flush()
-                printed = text
-
+        printer = StreamPrinter(tokenizer, stop_seqs)
         try:
             if args.pipeline_stages:
-                # stream via the ring's collect callback through the shared
-                # stop-prefix hold-back (same filter as generate_chat) —
-                # the engine's returned list is authoritative and flushes
-                # any held remainder below
-                filt = StopPrefixFilter(stop_seqs, emit_tok)
-
-                def on_tok(_j: int, tok: int):
-                    filt.push(tok)
-
+                # stream via the ring's collect callback; the engine's
+                # returned (trimmed) list is authoritative — finish()
+                # flushes any held-back remainder
                 outs, _ = eng.generate(
                     [context],
                     args.n_tokens,
@@ -161,13 +142,11 @@ def main(argv=None):
                     top_k=args.top_k,
                     top_p=args.top_p,
                     stop_sequences=stop_seqs,
-                    stream_cb=on_tok,
+                    stream_cb=lambda _j, tok: printer.push(tok),
                 )
-                final = outs[0][len(context) :]
-                for tok in final[len(reply_ids) :]:
-                    emit_tok(tok)
-                reply_ids = final
+                printer.finish(outs[0][len(context) :])
             else:
+                # generate_chat already filters stop sequences: raw emit
                 for tok in eng.generate_chat(
                     context,
                     args.n_tokens,
@@ -176,11 +155,11 @@ def main(argv=None):
                     top_p=args.top_p,
                     stop_sequences=stop_seqs,
                 ):
-                    emit_tok(tok)
+                    printer.emit(tok)
         except KeyboardInterrupt:
             print("\n[interrupted]")
         print()
-        history = context + reply_ids
+        history = context + printer.reply
     return 0
 
 

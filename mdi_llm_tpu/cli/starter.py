@@ -66,6 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="stages to split over (default: one per chip in the job)",
     )
     ap.add_argument(
+        "--stream",
+        action="store_true",
+        help="print sample 0's text live as its tokens come back around "
+        "the ring (≡ the reference starter surfacing tokens as they "
+        "arrive, gptserver.py:904-956)",
+    )
+    ap.add_argument(
         "--samples-per-slot",
         type=int,
         default=1,
@@ -175,6 +182,18 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         tp=spec.get("tp", 1),
         overlap_chunks=spec.get("overlap_chunks", False),
     )
+    # live console stream of sample 0 (host-side only: the callback never
+    # enters the traced ring program, so secondaries' SPMD step matches)
+    stream_cb = printer = None
+    if is_starter and getattr(args, "stream", False) and tokenizer is not None:
+        from mdi_llm_tpu.generation import StreamPrinter
+
+        printer = StreamPrinter(tokenizer, spec["stop_seqs"])
+
+        def stream_cb(j: int, tok: int):
+            if j == 0:
+                printer.push(tok)
+
     t0 = time.perf_counter()
     outs, stats = engine.generate(
         spec["prompt_ids"],
@@ -183,8 +202,13 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         top_k=spec["top_k"],
         top_p=spec["top_p"],
         stop_sequences=spec["stop_seqs"],
+        stream_cb=stream_cb,
     )
     gen_time = time.perf_counter() - t0
+    if printer is not None:
+        # reconcile with the trimmed result (flushes the held-back tail)
+        printer.finish(outs[0][len(spec["prompt_ids"][0]) :])
+        print()
 
     if not is_starter:
         log.info("secondary %d done (%d tokens)", process_id, stats.tokens_generated)

@@ -299,6 +299,72 @@ def test_chat_cli_pipeline_matches_single(tiny_ckpt, monkeypatch, capsys):
     assert single.split("Chatting with", 1)[1] == piped.split("Chatting with", 1)[1]
 
 
+def test_starter_stream_flag(tiny_ckpt, tmp_path, capsys):
+    """--stream prints sample 0's text live; output must equal the final
+    decoded sample text (same filter+trim contract as chat)."""
+    import json as _json
+
+    from mdi_llm_tpu.cli.starter import main as starter_main
+
+    cfg_p = tmp_path / "standalone.json"
+    cfg_p.write_text(_json.dumps({"nodes": {"starter": {"addr": "127.0.0.1",
+        "communication": {"port": 1}}, "secondary": []}}))
+    outs = starter_main(
+        ["--ckpt", str(tiny_ckpt), "--dtype", "float32", "--nodes-config",
+         str(cfg_p), "--n-tokens", "5", "--prompt", "the quick", "--greedy",
+         "--pipeline-stages", "2", "--stream"]
+    )
+    assert len(outs) == 1 and len(outs[0]) >= 3
+    captured = capsys.readouterr().out
+    # the streamed prefix (printed before report_run's '--- sample 0'
+    # header) must equal the decoded trimmed generation of sample 0 —
+    # incl. any tail the filter held back until finish()
+    from mdi_llm_tpu.utils.tokenizer import Tokenizer
+
+    tok = Tokenizer(tiny_ckpt)
+    n_prompt = len(tok.encode("the quick").tolist())
+    expected = tok.decode(np.asarray(outs[0][n_prompt:]))
+    streamed = captured.split("--- sample 0")[0].strip()
+    # (with this fixture the greedy continuation may decode to "" — the
+    # printer's emission/flush logic itself is pinned deterministically by
+    # test_stream_printer_unit)
+    assert streamed == expected.strip()
+
+
+def test_stream_printer_unit(capsys):
+    """StreamPrinter end-to-end with a fake tokenizer: incremental decode
+    prints only stabilized suffixes, the stop filter holds prefixes, and
+    finish() reconciles with the authoritative trimmed list."""
+    import sys
+
+    from mdi_llm_tpu.generation import StreamPrinter
+
+    class FakeTok:
+        def decode(self, ids):
+            return " ".join(f"w{int(i)}" for i in ids)
+
+    # no stop: everything streams; finish adds the tail the stream missed
+    p = StreamPrinter(FakeTok(), [], out=sys.stdout)
+    for t in (1, 2):
+        p.push(t)
+    assert p.finish([1, 2, 3]) == [1, 2, 3]
+    assert capsys.readouterr().out == "w1 w2 w3"
+
+    # stop sequence [8, 9]: held prefix never printed, finish is a no-op
+    p = StreamPrinter(FakeTok(), [[8, 9]], out=sys.stdout)
+    for t in (1, 8, 9, 5):
+        p.push(t)
+    assert p.finish([1]) == [1]
+    assert capsys.readouterr().out == "w1"
+
+    # budget end with a held near-miss prefix: finish flushes it
+    p = StreamPrinter(FakeTok(), [[8, 9]], out=sys.stdout)
+    for t in (1, 8):
+        p.push(t)  # 8 held back as a possible stop prefix
+    assert p.finish([1, 8]) == [1, 8]
+    assert capsys.readouterr().out == "w1 w8"
+
+
 def test_starter_debug_writes_role_log(tiny_ckpt, tmp_path):
     import json as _json
     import logging
