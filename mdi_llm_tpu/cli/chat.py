@@ -16,9 +16,6 @@ around the ring (gptserver.py:904-956).
 from __future__ import annotations
 
 import argparse
-import sys
-
-import numpy as np
 
 from mdi_llm_tpu.cli._common import (
     add_common_args,

@@ -185,14 +185,20 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
     # live console stream of sample 0 (host-side only: the callback never
     # enters the traced ring program, so secondaries' SPMD step matches)
     stream_cb = printer = None
-    if is_starter and getattr(args, "stream", False) and tokenizer is not None:
-        from mdi_llm_tpu.generation import StreamPrinter
+    if is_starter and getattr(args, "stream", False):
+        if tokenizer is None:
+            log.warning(
+                "--stream needs a checkpoint with a tokenizer (--ckpt); "
+                "running without live output"
+            )
+        else:
+            from mdi_llm_tpu.generation import StreamPrinter
 
-        printer = StreamPrinter(tokenizer, spec["stop_seqs"])
+            printer = StreamPrinter(tokenizer, spec["stop_seqs"])
 
-        def stream_cb(j: int, tok: int):
-            if j == 0:
-                printer.push(tok)
+            def stream_cb(j: int, tok: int):
+                if j == 0:
+                    printer.push(tok)
 
     t0 = time.perf_counter()
     outs, stats = engine.generate(

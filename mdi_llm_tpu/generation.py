@@ -226,6 +226,8 @@ class GenerationStats:
     rotations: int = 0
     # pipeline engine: lanes refilled token-by-token (partial-slot refills)
     token_fills: int = 0
+    # Generator: batch compactions performed (early-stop lane reclaim)
+    compactions: int = 0
     # True when the decode loop ended on Ctrl-C (partial output)
     interrupted: bool = False
 
@@ -486,6 +488,7 @@ class Generator:
         stream_cb=None,
         chunk_size: int = 16,
         speculative: Optional[int] = None,
+        compact: bool = True,
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for a batch of token-id prompts.
 
@@ -497,6 +500,14 @@ class Generator:
         amortize host-dispatch latency; stop sequences are checked between
         chunks, so up to chunk_size-1 extra tokens are computed then
         discarded — the token stream itself is unchanged.
+
+        `compact` (unmeshed runs only) reclaims lanes of early-stopped
+        samples by gathering the survivors into a smaller batch, so decode
+        HBM traffic tracks the LIVE sample count.  Greedy token streams
+        are unchanged (pure gather); with temperature > 0 the surviving
+        samples keep their distribution but not their exact RNG draws
+        (the batch shape feeds the sampler) — pass compact=False for
+        draw-level reproducibility across different stop configurations.
 
         `speculative=K` enables greedy speculative decoding with
         prompt-lookup (n-gram) drafting: K tokens are drafted from earlier
@@ -556,15 +567,18 @@ class Generator:
         done = [False] * B
         positions = np.asarray(lens, np.int32)
         t_dec = time.perf_counter()
+        # decode lane -> original sample index (None = padding after a batch
+        # compaction); every per-lane structure below is indexed through it
+        lanes: List[Optional[int]] = list(range(B))
 
         def emit(toks_bvec, n_emitted):
-            for b in range(B):
-                if not done[b]:
-                    out[b].append(int(toks_bvec[b]))
+            for b, j in enumerate(lanes):
+                if j is not None and not done[j]:
+                    out[j].append(int(toks_bvec[b]))
                     if stream_cb is not None:
-                        stream_cb(b, int(toks_bvec[b]))
-                    if detect_stop_tokens(out[b][lens[b] :], stop_sequences):
-                        done[b] = True
+                        stream_cb(j, int(toks_bvec[b]))
+                    if detect_stop_tokens(out[j][lens[j] :], stop_sequences):
+                        done[j] = True
             stats.tok_time.append((n_emitted, time.perf_counter() - t0))
 
         n = 1
@@ -637,15 +651,52 @@ class Generator:
             stats.interrupted = g_spec.interrupted
             # the plain loop below finishes any tail the cache window allows
 
+        # mesh runs keep their lane count: the KV sharding is laid out for
+        # the original dp-divisible batch
+        compact_enabled = compact and self.mesh is None
+
+        def compact_lanes():
+            """Batch compaction: once enough samples have finished that the
+            live set fits a power-of-two bucket <= half the current lane
+            count, gather the surviving lanes (KV cache, last tokens,
+            positions) into the smaller batch — decode bytes/step are
+            proportional to the lane count, so early-stopping workloads
+            stop paying full-batch HBM traffic for dead lanes (the
+            single-chip analog of the pipeline engine's slot refill).
+            Greedy streams are unchanged (pure gather); sampled streams
+            keep their distribution but not their exact draws."""
+            nonlocal kv, tok, positions, lanes
+            active = [b for b, j in enumerate(lanes) if j is not None and not done[j]]
+            if not active or len(lanes) <= 1:
+                return
+            nB = 1
+            while nB < len(active):
+                nB *= 2
+            # floor at 4 lanes: each new lane count compiles a fresh decode
+            # chunk per chunk width, and below 4 lanes the reclaimed HBM
+            # traffic can no longer repay a multi-second XLA compile
+            nB = max(nB, min(4, len(lanes)))
+            if nB > len(lanes) // 2:
+                return
+            sel = active + [active[0]] * (nB - len(active))
+            sel_j = jnp.asarray(sel, jnp.int32)
+            kv = {kk: vv[:, sel_j] for kk, vv in kv.items()}
+            tok = tok[np.asarray(sel)]
+            positions = positions[np.asarray(sel)]
+            lanes = [lanes[b] for b in active] + [None] * (nB - len(active))
+            stats.compactions += 1
+
         # Ctrl-C mid-loop returns what was generated so far
         # (≡ catch_loop_errors clean shutdown, context_managers.py:16-57)
         with catch_loop_errors() as guard:
             while n < max_new_tokens and not all(done) and not stats.interrupted:
+                if compact_enabled:
+                    compact_lanes()
                 room = cache_len - int(positions.max()) - 1
                 k = min(chunk_size, max_new_tokens - n, room)
                 if k < 1:
                     break
-                toks_j, kv, self.key = self._decode_chunk_fn(B, k)(
+                toks_j, kv, self.key = self._decode_chunk_fn(len(lanes), k)(
                     self.params,
                     jnp.asarray(tok, jnp.int32),
                     kv,
@@ -655,7 +706,7 @@ class Generator:
                     top_k=top_k,
                     top_p=top_p,
                 )
-                toks_np = np.asarray(toks_j)  # (k, B)
+                toks_np = np.asarray(toks_j)  # (k, len(lanes))
                 for i in range(k):
                     n += 1
                     emit(toks_np[i], n)
