@@ -73,9 +73,12 @@ def _gelu(cfg: Config, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def mlp_forward(
-    cfg: Config, p: Params, x: jnp.ndarray, moe_impl=None
-) -> jnp.ndarray:
+    cfg: Config, p: Params, x: jnp.ndarray, moe_impl=None, moe_aux: bool = False
+):
+    # returns the (B, T, D) output; with moe_aux (LLaMAMoE only), (out, aux)
     kind = cfg.mlp_class_name
+    if moe_aux and kind != "LLaMAMoE":
+        raise ValueError(f"moe_aux requires an MoE config (got {kind!r})")
     if kind == "GptNeoxMLP":
         return linear(_gelu(cfg, linear(x, p["fc"])), p["proj"])
     if kind == "LLaMAMLP":
@@ -83,11 +86,15 @@ def mlp_forward(
     if kind == "GemmaMLP":
         return linear(_gelu(cfg, linear(x, p["fc_1"])) * linear(x, p["fc_2"]), p["proj"])
     if kind == "LLaMAMoE":
+        if moe_aux:  # impl returns (out, load-balancing aux loss)
+            return (moe_impl or moe_forward)(cfg, p, x, with_aux=True)
         return (moe_impl or moe_forward)(cfg, p, x)
     raise ValueError(f"unknown mlp_class_name {kind!r}")
 
 
-def moe_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+def moe_forward(
+    cfg: Config, p: Params, x: jnp.ndarray, with_aux: bool = False
+):
     """Top-k routed mixture of experts (reference `LLaMAMoE`,
     model.py:823-853).
 
@@ -96,6 +103,15 @@ def moe_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     On TPU this keeps shapes static and the MXU busy; the token-dispatch
     expert-parallel variant (all_to_all over an `ep` mesh axis) is
     `parallel/expert.ep_moe_forward`, passed in here via `moe_impl`.
+
+    `with_aux` also returns the Switch/GShard load-balancing auxiliary loss
+    `E · Σ_e f_e · P_e` — `f_e` the fraction of top-k assignments routed to
+    expert e, `P_e` the mean router probability on e; 1.0 at perfectly
+    uniform routing, larger when imbalanced.  Gradient reaches the gate
+    through `P_e` (the assignment counts are stop-gradiented, as in Switch
+    Transformer).  The reference trains its MoE with no balancing term
+    (model.py:823-853); this is the TPU-first addition that keeps
+    sharded-expert training balanced.
     """
     E = cfg.n_expert
     router = quantized_einsum("...i,ei->...e", x, p["gate"]).astype(jnp.float32)
@@ -111,7 +127,17 @@ def moe_forward(cfg: Config, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     h2 = quantized_einsum("...d,eid->...ei", x, p["experts"]["fc_2"])
     h = jax.nn.silu(h1) * h2
     out = quantized_einsum("...ei,edi->...ed", h, p["experts"]["proj"])
-    return jnp.einsum("...ed,...e->...d", out, dense_w.astype(out.dtype)).astype(x.dtype)
+    y = jnp.einsum("...ed,...e->...d", out, dense_w.astype(out.dtype)).astype(x.dtype)
+    if not with_aux:
+        return y
+    k = cfg.n_expert_per_token
+    n_tokens = probs.size // E
+    assign = jnp.sum(
+        jax.lax.stop_gradient(onehot).reshape(-1, E), axis=0
+    )  # (E,) top-k assignment counts
+    f = assign / jnp.asarray(n_tokens * k, jnp.float32)
+    pm = jnp.mean(probs.reshape(-1, E), axis=0)
+    return y, E * jnp.sum(f * pm)
 
 
 # ---------------------------------------------------------------------------
@@ -271,9 +297,13 @@ def block_forward(
     use_flash: bool = False,
     sp_meta: Optional[Tuple] = None,
     moe_impl=None,
+    collect_moe_aux: bool = False,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
-    parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms."""
+    parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms.
+
+    With `collect_moe_aux` (MoE training) the return gains a 4th element:
+    this layer's load-balancing auxiliary loss scalar."""
     n1 = _norm(cfg, x, p["norm_1"])
     att, k_cache, v_cache = attention_forward(
         cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis,
@@ -281,10 +311,21 @@ def block_forward(
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
-        x = x + att + mlp_forward(cfg, p["mlp"], n2, moe_impl)
+        mlp_out = mlp_forward(cfg, p["mlp"], n2, moe_impl, moe_aux=collect_moe_aux)
+        if collect_moe_aux:
+            mlp_out, aux = mlp_out
+        x = x + att + mlp_out
     else:
         x = x + att
-        x = x + mlp_forward(cfg, p["mlp"], _norm(cfg, x, p["norm_2"]), moe_impl)
+        mlp_out = mlp_forward(
+            cfg, p["mlp"], _norm(cfg, x, p["norm_2"]), moe_impl,
+            moe_aux=collect_moe_aux,
+        )
+        if collect_moe_aux:
+            mlp_out, aux = mlp_out
+        x = x + mlp_out
+    if collect_moe_aux:
+        return x, k_cache, v_cache, aux
     return x, k_cache, v_cache
 
 
@@ -304,15 +345,38 @@ def run_blocks(
     sp_meta: Optional[Tuple] = None,
     moe_impl=None,
     unroll: int = 1,
-) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    collect_moe_aux: bool = False,
+):
+    # returns (x, kv), or (x, kv, aux_sum) under collect_moe_aux
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
     rematerializes each block under autodiff (training memory ∝ 1 layer's
     activations instead of L — the TPU substitute for the reference's AMP
     memory savings, SURVEY.md §2.4).  `unroll` trades compile time for
     per-iteration loop overhead (decode steps are small enough that the
-    XLA while-loop bookkeeping is a measurable slice of each layer)."""
+    XLA while-loop bookkeeping is a measurable slice of each layer).
+
+    `collect_moe_aux` (MoE training, no KV cache) accumulates each layer's
+    load-balancing aux loss through the scan carry; the return gains the
+    layer-SUMMED aux scalar (caller normalizes by n_layer)."""
 
     if kv is None:
+        if collect_moe_aux:
+
+            def body(carry, layer_p):
+                h, acc = carry
+                y, _, _, aux = block_forward(
+                    cfg, layer_p, h, pos, cos, sin, None, None, input_pos,
+                    sp_axis, fresh_prefill, use_flash, moe_impl=moe_impl,
+                    collect_moe_aux=True,
+                )
+                return (y, acc + aux), None
+
+            if remat:
+                body = jax.checkpoint(body)
+            (x, aux_sum), _ = jax.lax.scan(
+                body, (x, jnp.zeros((), jnp.float32)), blocks, unroll=unroll
+            )
+            return x, None, aux_sum
 
         def body(carry, layer_p):
             y, _, _ = block_forward(
@@ -325,6 +389,9 @@ def run_blocks(
             body = jax.checkpoint(body)
         x, _ = jax.lax.scan(body, x, blocks, unroll=unroll)
         return x, None
+
+    if collect_moe_aux:
+        raise ValueError("collect_moe_aux is a training path (kv must be None)")
 
     def body(carry, xs):
         layer_p, k_c, v_c = xs
@@ -381,8 +448,13 @@ def forward(
     sp_meta: Optional[Tuple] = None,
     moe_impl=None,
     unroll: int = 1,
-) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    collect_moe_aux: bool = False,
+):
+    # returns (logits, kv), or (logits, kv, aux_sum) under collect_moe_aux
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
+
+    `collect_moe_aux` (MoE training) adds a 3rd return: the layer-summed
+    load-balancing auxiliary loss (see `moe_forward`).
 
     Works for prefill (T = prompt chunk) and decode (T = 1) alike; the same
     traced function is reused whenever shapes match (shape-bucketing lives in
@@ -403,11 +475,16 @@ def forward(
     cos = jnp.take(rope[0], pos, axis=0)
     sin = jnp.take(rope[1], pos, axis=0)
     x = embed(cfg, params, tokens, pos)
-    x, kv = run_blocks(
+    out = run_blocks(
         cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat,
         sp_axis=sp_axis, fresh_prefill=fresh_prefill, use_flash=use_flash,
         sp_meta=sp_meta, moe_impl=moe_impl, unroll=unroll,
+        collect_moe_aux=collect_moe_aux,
     )
+    if collect_moe_aux:
+        x, kv, aux_sum = out
+        return head(cfg, params, x), kv, aux_sum
+    x, kv = out
     return head(cfg, params, x), kv
 
 

@@ -57,12 +57,18 @@ def expert_capacity(
     return max(1, math.ceil(need))
 
 
-def _local_moe(cfg: Config, ep: int, C: int, axis: str, xs, valid, p):
+def _local_moe(
+    cfg: Config, ep: int, C: int, axis: str, with_aux: bool,
+    dp_axis: Optional[str], xs, valid, p
+):
     """Per-device body (inside shard_map): route, dispatch, compute, combine.
 
     xs: (1, n, D) local token shard; valid: (1, n) bool (False for padding
     rows, which must neither consume capacity nor emit output); p: mlp param
     dict with experts' leading axis sharded to the local E/ep slice.
+    With `with_aux`, also returns the load-balancing auxiliary loss
+    (globally psum-reduced over `axis`, so every device holds the same
+    scalar) — see `models/transformer.moe_forward` for the formula.
     """
     x = xs[0]
     n, D = x.shape
@@ -117,7 +123,26 @@ def _local_moe(cfg: Config, ep: int, C: int, axis: str, xs, valid, p):
     outd = back.reshape(E, C, D)
     y = outd[flat_e, pos_c] * (flat_w[:, None] * contrib[:, None]).astype(x.dtype)
     out = jnp.zeros((n, D), x.dtype).at[flat_tok].add(y)
-    return out[None]
+    if not with_aux:
+        return out[None]
+
+    # load-balancing stats over the GLOBAL token population: pre-drop
+    # assignment counts (router intent, independent of capacity) and mean
+    # router probability per expert, psum-reduced over the ep axis so the
+    # formula matches the dense path exactly
+    assign = jnp.sum(onehot.astype(jnp.float32), axis=0)  # (E,)
+    prob_sum = jnp.sum(
+        probs * vmask[:, None].astype(probs.dtype), axis=0
+    )  # (E,)
+    n_valid = jnp.sum(vmask.astype(jnp.float32))
+    red = (dp_axis, axis) if dp_axis else axis
+    assign, prob_sum, n_valid = jax.lax.psum(
+        (assign, prob_sum, n_valid), red
+    )
+    f = assign / jnp.maximum(n_valid * k, 1.0)
+    pm = prob_sum / jnp.maximum(n_valid, 1.0)
+    aux = E * jnp.sum(f * pm)
+    return out[None], aux[None]
 
 
 def ep_moe_forward(
@@ -127,26 +152,44 @@ def ep_moe_forward(
     mesh: Mesh,
     axis: str = "ep",
     capacity_factor: Optional[float] = None,
-) -> jnp.ndarray:
+    with_aux: bool = False,
+    dp_axis: Optional[str] = None,
+):
     """Expert-parallel MoE layer: drop-in for `transformer.moe_forward`
     (pass as `moe_impl=` through `transformer.forward`).  Tokens are split
     over the `axis` devices, experts dispatched via all_to_all; output is
-    replicated like the input."""
+    replicated like the input.  Returns the (B, T, D) output, or
+    `(output, aux)` with `with_aux`.
+
+    `with_aux` additionally returns the load-balancing auxiliary loss
+    (same formula as the dense path — see `transformer.moe_forward`), used
+    by MoE training.  The whole dispatch is differentiable (`all_to_all`
+    transposes to the reverse all_to_all), so this path trains.
+
+    `dp_axis` (training on a (dp, ep) mesh): split tokens over BOTH axes so
+    each device routes N/(dp·ep) tokens instead of every dp replica
+    redundantly routing N/ep — the dispatch all_to_all stays within each dp
+    row, expert shards are dp-replicated (their gradient psum over dp falls
+    out of the shard_map transpose), and the aux stats reduce over both
+    axes.  Without it, a dp-sharded activation would also be all-gathered
+    by GSPMD at every MoE layer just to feed the ep-only split."""
     ep = int(mesh.shape[axis])
     E = cfg.n_expert
     if E % ep:
         raise ValueError(f"n_expert={E} not divisible by {axis}={ep}")
+    dp = int(mesh.shape[dp_axis]) if dp_axis else 1
+    splits = dp * ep
     B, T, D = x.shape
     N = B * T
-    n_loc = -(-N // ep)
-    Np = n_loc * ep
+    n_loc = -(-N // splits)
+    Np = n_loc * splits
     C = expert_capacity(cfg, n_loc, capacity_factor)
 
     xf = x.reshape(N, D)
     if Np > N:
         xf = jnp.pad(xf, ((0, Np - N), (0, 0)))
-    xs = xf.reshape(ep, n_loc, D)
-    valid = (jnp.arange(Np) < N).reshape(ep, n_loc)
+    xs = xf.reshape(splits, n_loc, D)
+    valid = (jnp.arange(Np) < N).reshape(splits, n_loc)
 
     def leaf_spec(shard_first):
         return lambda a: P(axis, *([None] * (a.ndim - 1))) if shard_first else P(
@@ -157,12 +200,19 @@ def ep_moe_forward(
         "gate": jax.tree_util.tree_map(leaf_spec(False), p["gate"]),
         "experts": jax.tree_util.tree_map(leaf_spec(True), p["experts"]),
     }
-    body = partial(_local_moe, cfg, ep, C, axis)
+    tok = (dp_axis, axis) if dp_axis else axis
+    body = partial(_local_moe, cfg, ep, C, axis, with_aux, dp_axis)
+    out_specs = (
+        (P(tok, None, None), P(tok)) if with_aux else P(tok, None, None)
+    )
     out = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis, None, None), P(axis, None), p_specs),
-        out_specs=P(axis, None, None),
+        in_specs=(P(tok, None, None), P(tok, None), p_specs),
+        out_specs=out_specs,
         check_vma=False,
     )(xs, valid, {"gate": p["gate"], "experts": p["experts"]})
+    if with_aux:
+        out, aux = out
+        return out.reshape(Np, D)[:N].reshape(B, T, D), aux[0]
     return out.reshape(Np, D)[:N].reshape(B, T, D)

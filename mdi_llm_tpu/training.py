@@ -76,6 +76,14 @@ class TrainingConfig:
     # (model.py:738-751).  sp training keeps the ring-attention path (its
     # blockwise online softmax already avoids the (T, T) materialization).
     use_flash: Optional[bool] = None
+    # MoE training (LLaMAMoE configs only): weight on the Switch/GShard
+    # load-balancing auxiliary loss (transformer.moe_forward docstring);
+    # 0 disables (pure CE, the reference's behavior, model.py:823-853).
+    # The capacity factor bounds the dispatch buffers for expert-parallel
+    # (`ep` mesh) training; None → exact capacity (no drops, grads match
+    # the dense formulation bit-for-bit).
+    moe_aux_weight: float = 0.01
+    moe_capacity_factor: Optional[float] = None
 
 
 def get_lr(it: int, tc: TrainingConfig) -> float:
@@ -93,22 +101,34 @@ def get_lr(it: int, tc: TrainingConfig) -> float:
 
 
 def cross_entropy_loss(
-    cfg: Config, params, tokens, targets, remat=True, use_flash=False
+    cfg: Config, params, tokens, targets, remat=True, use_flash=False,
+    moe_impl=None, moe_aux_weight=0.0,
 ):
     """Mean next-token CE in f32 (vocab padding columns get -inf'd out by
     the softmax normalizer naturally since their logits are finite but the
-    targets never point at them)."""
-    logits, _ = transformer.forward(
+    targets never point at them).
+
+    `moe_impl` routes MoE layers through an alternative implementation
+    (`parallel.expert.ep_moe_forward` for token-dispatch expert-parallel
+    training); `moe_aux_weight` > 0 adds the load-balancing auxiliary loss
+    (layer-mean) for LLaMAMoE configs."""
+    collect = moe_aux_weight > 0 and cfg.mlp_class_name == "LLaMAMoE"
+    out = transformer.forward(
         cfg,
         params,
         tokens,
         jnp.zeros((tokens.shape[0],), jnp.int32),
         remat=remat,
         use_flash=use_flash,
+        moe_impl=moe_impl,
+        collect_moe_aux=collect,
     )
-    logits = logits.astype(jnp.float32)
+    logits = out[0].astype(jnp.float32)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    return losses.mean()
+    loss = losses.mean()
+    if collect:
+        loss = loss + moe_aux_weight * out[2] / cfg.n_layer
+    return loss
 
 
 def lr_schedule(tc: TrainingConfig):
@@ -221,6 +241,53 @@ class Trainer:
 
         self.sp = mesh is not None and "sp" in mesh.axis_names
         self.pp = mesh is not None and "pp" in mesh.axis_names
+        # expert parallelism: tokens dispatched to ep-sharded experts via
+        # all_to_all inside the loss (parallel/expert.ep_moe_forward) —
+        # the TPU-first redesign of what the reference cannot do at all
+        # (its MoE always runs whole on one device, model.py:823-853)
+        self.ep = mesh is not None and "ep" in mesh.axis_names
+        self._moe_impl = None
+        if self.ep:
+            if cfg.mlp_class_name != "LLaMAMoE":
+                raise ValueError(
+                    f"'ep' mesh axis needs an MoE config; {cfg.name} has "
+                    f"mlp_class_name={cfg.mlp_class_name!r}"
+                )
+            if self.sp or self.pp:
+                raise ValueError("ep composes with dp only (ep×sp/pp: future work)")
+            if cfg.n_expert % int(mesh.shape["ep"]):
+                raise ValueError(
+                    f"n_expert={cfg.n_expert} not divisible by "
+                    f"ep={int(mesh.shape['ep'])}"
+                )
+            from mdi_llm_tpu.parallel.expert import ep_moe_forward
+
+            self._moe_impl = partial(
+                ep_moe_forward,
+                mesh=mesh,
+                axis="ep",
+                capacity_factor=tc.moe_capacity_factor,
+                # split tokens over dp×ep so MoE cost scales with BOTH axes
+                dp_axis="dp" if "dp" in mesh.axis_names else None,
+            )
+        self._moe_aux_w = (
+            tc.moe_aux_weight if cfg.mlp_class_name == "LLaMAMoE" else 0.0
+        )
+        if self._moe_aux_w and (self.sp or self.pp):
+            # the sp/pp loss functions run their own shard_map ring and do
+            # not thread the per-layer aux accumulator; training proceeds as
+            # pure CE there (the reference's behavior) — say so rather than
+            # silently dropping the term the config promises
+            import sys
+
+            print(
+                "warning: moe_aux_weight is not applied on sp/pp training "
+                "meshes (MoE trains dense, pure CE there); set "
+                "moe_aux_weight=0 to silence",
+                file=sys.stderr,
+            )
+            self._moe_aux_w = 0.0
+        self.dp_axis: Optional[str] = "dp"
         if self.pp:
             # GPipe-style pipeline-parallel training over a ("dp", "pp")
             # mesh: stage-sharded blocks, microbatched ring forward
@@ -265,7 +332,7 @@ class Trainer:
             # sequence parallelism uses explicit shard_map collectives; params
             # stay replicated there (tp+sp composition is future work)
             tp = "tp" if ("tp" in mesh.axis_names and not self.sp) else None
-            pspecs = param_specs(cfg, tp)
+            pspecs = param_specs(cfg, tp, ep_axis="ep" if self.ep else None)
             self.param_shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s), pspecs
             )
@@ -273,7 +340,9 @@ class Trainer:
                 jax.device_put, params, self.param_shardings
             )
             seq_axis = "sp" if self.sp else None
-            self.batch_sharding = NamedSharding(mesh, P("dp", seq_axis))
+            dp_axis = "dp" if "dp" in mesh.axis_names else None
+            self.dp_axis = dp_axis
+            self.batch_sharding = NamedSharding(mesh, P(dp_axis, seq_axis))
         else:
             self.param_shardings = None
             self.batch_sharding = None
@@ -418,7 +487,8 @@ class Trainer:
 
             def loss_fn(params, x, y):
                 return cross_entropy_loss(
-                    cfg, params, x, y, remat=tc.remat, use_flash=self.use_flash
+                    cfg, params, x, y, remat=tc.remat, use_flash=self.use_flash,
+                    moe_impl=self._moe_impl, moe_aux_weight=self._moe_aux_w,
                 )
 
         def step(params, opt_state, xs, ys):
@@ -442,7 +512,7 @@ class Trainer:
         if self.mesh is None:
             return jax.jit(step, donate_argnums=donate)
         seq_axis = "sp" if self.sp else None
-        micro_batch_sh = NamedSharding(self.mesh, P(None, "dp", seq_axis))
+        micro_batch_sh = NamedSharding(self.mesh, P(None, self.dp_axis, seq_axis))
         return jax.jit(
             step,
             donate_argnums=donate,
@@ -460,8 +530,11 @@ class Trainer:
         else:
 
             def ev(params, x, y):
+                # eval stays pure CE (comparable across aux-weight settings;
+                # early stopping tracks modeling quality, not router balance)
                 return cross_entropy_loss(
-                    cfg, params, x, y, remat=False, use_flash=self.use_flash
+                    cfg, params, x, y, remat=False, use_flash=self.use_flash,
+                    moe_impl=self._moe_impl,
                 )
 
         if self.mesh is None:

@@ -244,3 +244,135 @@ def test_generator_ep_decode_parity(devices):
     )
     txt = lowered.as_text()
     assert "all_to_all" in txt or "all-to-all" in txt
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel TRAINING (VERDICT r4 #4): aux loss + differentiable dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_moe_aux_loss_dense_vs_ep(devices):
+    """The load-balancing aux loss psum-reduced across the ep mesh equals the
+    dense single-device formula, and behaves (≈1 uniform, >1 skewed)."""
+    cfg = moe_config(E=4, k=2)
+    p = moe_layer_params(cfg, seed=5)
+    mesh = make_mesh({"ep": 4}, devices[:4])
+    x = jnp.asarray(
+        np.random.default_rng(6).standard_normal((2, 8, cfg.n_embd)).astype(np.float32)
+    )
+
+    yd, aux_d = moe_forward(cfg, p, x, with_aux=True)
+    ye, aux_e = ep_moe_forward(cfg, p, x, mesh, with_aux=True)
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(yd), atol=2e-5)
+    np.testing.assert_allclose(float(aux_e), float(aux_d), rtol=1e-5)
+
+    # deterministically skewed routing (identical tokens, gate favoring
+    # expert 0) must score worse than the near-uniform random case
+    p_skew = dict(p)
+    gate = np.zeros_like(np.asarray(p["gate"]["weight"]))
+    gate[0] = 1.0
+    p_skew["gate"] = {"weight": jnp.asarray(gate)}
+    x_const = jnp.full((2, 8, cfg.n_embd), 0.1, jnp.float32)
+    _, aux_skew = moe_forward(cfg, p_skew, x_const, with_aux=True)
+    assert float(aux_skew) > 1.5 > float(aux_d) >= 0.99  # near-uniform ≈ 1
+
+
+def test_ep_training_grad_parity(devices):
+    """Grads of the CE loss through token-dispatch EP (exact capacity) match
+    the dense formulation — all_to_all and the scatter/gather transpose
+    correctly.  Checked with and without the aux term."""
+    from mdi_llm_tpu.training import cross_entropy_loss
+
+    cfg = moe_config(E=4, k=2, n_layer=2)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), params)
+    mesh = make_mesh({"ep": 4}, devices[:4])
+    rng = np.random.default_rng(8)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)), jnp.int32)
+    tgts = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)), jnp.int32)
+    impl = partial(ep_moe_forward, mesh=mesh, capacity_factor=None)
+
+    gate_grads = {}
+    for aux_w in (0.0, 0.05):
+        ld, gd = jax.value_and_grad(
+            lambda p: cross_entropy_loss(
+                cfg, p, toks, tgts, remat=False, moe_aux_weight=aux_w
+            )
+        )(params)
+        le, ge = jax.value_and_grad(
+            lambda p: cross_entropy_loss(
+                cfg, p, toks, tgts, remat=False, moe_impl=impl,
+                moe_aux_weight=aux_w,
+            )
+        )(params)
+        np.testing.assert_allclose(float(le), float(ld), rtol=2e-5)
+        flat_d = jax.tree_util.tree_leaves_with_path(gd)
+        flat_e = {
+            jax.tree_util.keystr(k): v
+            for k, v in jax.tree_util.tree_leaves_with_path(ge)
+        }
+        for k, vd in flat_d:
+            ve = flat_e[jax.tree_util.keystr(k)]
+            np.testing.assert_allclose(
+                np.asarray(ve), np.asarray(vd), atol=5e-5,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(k)} aux_w={aux_w}",
+            )
+        gate_grads[aux_w] = np.asarray(ge["blocks"]["mlp"]["gate"]["weight"])
+    # the aux term must actually move the gate gradient (a vacuous check
+    # against zero would pass even if aux were constant w.r.t. the gate,
+    # since CE alone already reaches the gate through the top-k weights)
+    assert float(np.abs(gate_grads[0.05] - gate_grads[0.0]).max()) > 1e-7
+
+
+def test_trainer_ep_step_and_jaxpr(devices):
+    """Trainer on a (dp, ep) mesh: experts sharded over ep, one optimizer
+    step runs, and the compiled step contains the all_to_all dispatch."""
+    from mdi_llm_tpu.training import Trainer, TrainingConfig
+
+    cfg = moe_config(E=8, k=2, n_layer=2)
+    mesh = make_mesh({"dp": 2, "ep": 4}, devices)
+    tc = TrainingConfig(
+        batch_size=4, block_size=16, max_iters=2, dtype="float32",
+        warmup_iters=1, eval_iters=1, moe_aux_weight=0.01,
+    )
+    tr = Trainer(cfg, tc, mesh=mesh)
+    assert tr._moe_impl is not None
+    fc1 = tr.params["blocks"]["mlp"]["experts"]["fc_1"]["weight"]
+    assert "ep" in str(fc1.sharding.spec)
+
+    rng = np.random.default_rng(9)
+    xs = rng.integers(0, cfg.vocab_size, (1, 4, 16)).astype(np.int32)
+    ys = rng.integers(0, cfg.vocab_size, (1, 4, 16)).astype(np.int32)
+    lowered = tr._step.lower(
+        tr.params, tr.opt_state, jnp.asarray(xs), jnp.asarray(ys)
+    ).as_text()
+    assert "all_to_all" in lowered or "all-to-all" in lowered
+
+    losses = [tr.train_step(xs, ys) for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # same batch: the optimizer makes progress
+
+
+def test_trainer_ep_requires_moe():
+    from mdi_llm_tpu.training import Trainer, TrainingConfig
+    from mdi_llm_tpu.config import Config
+
+    cfg = Config.from_name("pythia-14m")
+    mesh = make_mesh({"ep": 4}, jax.devices()[:4])
+    with pytest.raises(ValueError, match="MoE config"):
+        Trainer(cfg, TrainingConfig(batch_size=2, block_size=16), mesh=mesh)
+
+
+def test_ep_dispatch_splits_tokens_over_dp(devices):
+    """With dp_axis, tokens split over dp×ep (each device routes N/(dp·ep))
+    and the result + aux still match the dense formulation."""
+    cfg = moe_config(E=4, k=2)
+    p = moe_layer_params(cfg, seed=11)
+    mesh = make_mesh({"dp": 2, "ep": 4}, devices)
+    x = jnp.asarray(
+        np.random.default_rng(12).standard_normal((2, 8, cfg.n_embd)).astype(np.float32)
+    )
+    yd, aux_d = moe_forward(cfg, p, x, with_aux=True)
+    ye, aux_e = ep_moe_forward(cfg, p, x, mesh, with_aux=True, dp_axis="dp")
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(yd), atol=2e-5)
+    np.testing.assert_allclose(float(aux_e), float(aux_d), rtol=1e-5)
