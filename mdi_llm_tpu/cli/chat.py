@@ -7,10 +7,12 @@ accumulating turn tokens, stop on the style's stop sequences.
 
 Streaming backends: single device (default), tensor-parallel
 (`--tp-devices N`), expert-parallel for MoE configs (`--ep-devices N`,
-GShard token dispatch), or the recurrent pipeline ring
-(`--pipeline-stages N`) — the last matching the reference's distributed
-chat experience where the starter surfaces tokens as they come back
-around the ring (gptserver.py:904-956).
+GShard token dispatch), sequence-parallel (`--sp-devices N`, ring-attention
+prefill + sequence-sharded KV so the conversation window scales with N
+chips; composes with `--quantize` for long-context 8B-class serving), or
+the recurrent pipeline ring (`--pipeline-stages N`) — the last matching
+the reference's distributed chat experience where the starter surfaces
+tokens as they come back around the ring (gptserver.py:904-956).
 """
 
 from __future__ import annotations
@@ -57,6 +59,34 @@ def build_parser():
         "GShard token dispatch over an ep mesh)",
     )
     ap.add_argument(
+        "--sp-devices",
+        type=int,
+        default=0,
+        help="sequence-parallel streaming over N devices: ring-attention "
+        "prefill + sequence-sharded KV cache, so the conversation window "
+        "scales with N chips (composes with --quantize)",
+    )
+    ap.add_argument(
+        "--sp-flash",
+        action="store_true",
+        help="run the sp prefill ring through the Pallas flash kernel "
+        "(TPU opt-in; engages when the local chunk is >= 2048)",
+    )
+    ap.add_argument(
+        "--sp-chunk",
+        type=int,
+        default=8,
+        help="sp streaming: decode steps batched per dispatch — smaller = "
+        "lower time-to-first-byte, larger = higher throughput",
+    )
+    ap.add_argument(
+        "--moe-capacity-factor",
+        type=float,
+        default=None,
+        help="expert-parallel dispatch capacity factor (see cli/sample.py); "
+        "default exact/no-drop",
+    )
+    ap.add_argument(
         "--rotations-per-call",
         type=int,
         default=2,
@@ -70,11 +100,14 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     setup_logging(args)
     select_device(args)
-    if sum(bool(f) for f in (args.tp_devices, args.pipeline_stages, args.ep_devices)) > 1:
+    backends = (
+        args.tp_devices, args.pipeline_stages, args.ep_devices, args.sp_devices
+    )
+    if sum(bool(f) for f in backends) > 1:
         raise SystemExit(
-            "--tp-devices, --pipeline-stages and --ep-devices are separate "
-            "streaming backends; pick one (for a pipe x tp mesh use "
-            "cli/starter.py)"
+            "--tp-devices, --pipeline-stages, --ep-devices and --sp-devices "
+            "are separate streaming backends; pick one (for a pipe x tp "
+            "mesh use cli/starter.py)"
         )
     cfg, params, tokenizer, prompt_style = load_model(args)
     if tokenizer is None:
@@ -94,6 +127,16 @@ def main(argv=None):
             cache_dtype=resolve_kv_dtype(args.kv_dtype),
             rotations_per_call=args.rotations_per_call,
         )
+    elif args.sp_devices:
+        from mdi_llm_tpu.parallel.sp_inference import SPGenerator
+
+        eng = SPGenerator(
+            cfg, params, n_devices=args.sp_devices,
+            max_seq_length=args.sequence_length, rng_seed=args.seed,
+            cache_dtype=resolve_kv_dtype(args.kv_dtype),
+            decode_chunk=args.sp_chunk, use_flash=args.sp_flash,
+            quantize=args.quantize,
+        )
     else:
         mesh = None
         if args.tp_devices:
@@ -107,7 +150,7 @@ def main(argv=None):
         eng = Generator(
             cfg, params, max_seq_length=args.sequence_length, rng_seed=args.seed,
             quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
-            mesh=mesh,
+            mesh=mesh, moe_capacity_factor=args.moe_capacity_factor,
         )
 
     print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")

@@ -73,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
         "axis; composes with --quantize int8/w8a8/int4",
     )
     ap.add_argument(
+        "--moe-capacity-factor",
+        type=float,
+        default=None,
+        help="expert-parallel dispatch capacity factor: bounds the per-"
+        "device dispatch buffers at cf*k/E of the no-drop worst case "
+        "(Switch-style drops past capacity); default exact/no-drop — "
+        "long-prompt MoE prefill may want ~1.25 to cap activation memory",
+    )
+    ap.add_argument(
         "--tp-devices",
         type=int,
         default=0,
@@ -159,14 +168,12 @@ def main(argv=None):
                 raise SystemExit("--sp-devices and --pipeline-stages are exclusive")
             if args.speculative:
                 raise SystemExit("--speculative applies to single-device decode only")
-            if args.quantize not in (None, "none"):
-                raise SystemExit("--quantize is not supported with --sp-devices yet")
             from mdi_llm_tpu.parallel.sp_inference import SPGenerator
 
             engine = SPGenerator(
                 cfg, params, n_devices=args.sp_devices, max_seq_length=seq_len,
                 rng_seed=args.seed, cache_dtype=resolve_kv_dtype(args.kv_dtype),
-                use_flash=args.sp_flash,
+                use_flash=args.sp_flash, quantize=args.quantize,
             )
             n_nodes = args.sp_devices
             outs, stats = engine.generate(
@@ -208,7 +215,7 @@ def main(argv=None):
             engine = Generator(
                 cfg, params, max_seq_length=seq_len, rng_seed=args.seed,
                 quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
-                mesh=mesh,
+                mesh=mesh, moe_capacity_factor=args.moe_capacity_factor,
             )
             outs, stats = engine.generate(
                 prompt_ids, args.n_tokens, temperature=temperature,

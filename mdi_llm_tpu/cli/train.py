@@ -59,8 +59,25 @@ def build_parser():
     ap.add_argument(
         "--mesh",
         default=None,
-        help='e.g. "dp=8", "dp=4,tp=2", "dp=2,sp=4" (ring attention), or '
-        '"dp=1,pp=4" (GPipe pipeline stages)',
+        help='e.g. "dp=8", "dp=4,tp=2", "dp=2,sp=4" (ring attention), '
+        '"dp=1,pp=4" (GPipe pipeline stages), or "dp=2,ep=4" (MoE '
+        'configs: token-dispatch expert parallelism)',
+    )
+    ap.add_argument(
+        "--moe-aux-weight",
+        type=float,
+        default=0.01,
+        help="MoE configs: weight on the load-balancing auxiliary loss "
+        "(0 disables; ignored with a warning on sp/pp meshes, where MoE "
+        "trains dense pure-CE)",
+    )
+    ap.add_argument(
+        "--moe-capacity-factor",
+        type=float,
+        default=None,
+        help="ep-mesh MoE training: dispatch capacity factor (bounds the "
+        "per-device buffers, Switch-style drops past capacity); default "
+        "exact/no-drop — gradients then match the dense formulation",
     )
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--process-id", type=int, default=None)
@@ -108,6 +125,8 @@ def main(argv=None):
         dtype=args.dtype if args.dtype != "float16" else "bfloat16",
         remat=not args.no_remat,
         use_flash={"auto": None, "on": True, "off": False}[args.use_flash],
+        moe_aux_weight=args.moe_aux_weight,
+        moe_capacity_factor=args.moe_capacity_factor,
     )
     mesh = parse_mesh(args.mesh)
     out_dir = Path(args.ckpt) if args.ckpt else Path("out")

@@ -167,12 +167,14 @@ def ngram_draft(tokens: Sequence[int], k: int, ngram: int = 3) -> List[int]:
     return []
 
 
-def _place_ep_quantized(params, mesh: Mesh):
+def _place_ep_quantized(params, mesh: Mesh, n_expert: int):
     """Place a (possibly quantized) MoE tree on an ep(+dp) mesh: every >=2-D
     leaf under an "experts" subtree shards axis 1 (the expert axis, after
     the stacked-layer axis) over "ep"; every other leaf replicates.  Works
     by position rather than leaf name, so weight_q/scale/weight_q4 layouts
-    need no dedicated spec table."""
+    need no dedicated spec table.  Positional placement is guarded by shape:
+    a future storage layout whose axis 1 is NOT the expert axis must fail
+    loudly here, not mis-shard silently."""
 
     def walk(node, in_experts):
         if isinstance(node, dict):
@@ -181,6 +183,12 @@ def _place_ep_quantized(params, mesh: Mesh):
             }
         nd = np.ndim(node)
         if in_experts and nd >= 2:
+            if node.shape[1] != n_expert:
+                raise ValueError(
+                    f"expert-subtree leaf has axis-1 size {node.shape[1]}, "
+                    f"expected n_expert={n_expert}; this storage layout "
+                    "needs its own ep placement rule"
+                )
             spec = P(None, "ep", *([None] * (nd - 2)))
         else:
             spec = P(*([None] * nd))
@@ -333,7 +341,7 @@ class Generator:
                 # name-agnostic placement: leaves under an "experts" subtree
                 # shard their (layer, expert, ...) expert axis over ep (this
                 # covers weight_q/scale layouts too); all else replicates
-                params = _place_ep_quantized(params, mesh)
+                params = _place_ep_quantized(params, mesh, cfg.n_expert)
             else:
                 params = shard_params(
                     params, cfg, mesh, "tp" if tp_n > 1 else None, ep_axis

@@ -377,7 +377,10 @@ class PipelineEngine:
             axis_names={"pipe"},
             check_vma=not self.multiprocess and self.tp == 1,
         )
-        return jax.jit(sm, donate_argnums=(3, 4))
+        # donate the KV buffers only: the injection payload is consumed but
+        # not among the outputs, so donating it just trips XLA's
+        # unusable-donation warning
+        return jax.jit(sm, donate_argnums=(3,))
 
     def _build_decode(self, temperature, top_k, top_p):
         cfg, S, mesh = self.cfg, self.n_stages, self.mesh

@@ -68,14 +68,23 @@ class SPGenerator:
         cache_dtype=None,
         rng_seed: int = 1337,
         decode_chunk: int = 32,
-        use_flash: bool = False,  # run prefill's ring attention through
-        # the Pallas flash kernel.  Explicit opt-in (not auto): the fused
+        use_flash=False,  # run prefill's ring attention through the
+        # Pallas flash kernel.  Explicit opt-in (not auto): the fused
         # sp ring is interpret/trace-tested but has not yet executed on
         # real TPU hardware — same reasoning as Trainer's sp opt-in.
-        # Flip to an auto default once a TPU run validates it.
+        # True is soft-gated on a TPU backend (warn + fall back on CPU,
+        # where the kernel cannot lower); "force" skips the gate for
+        # trace/interpret testing.  Flip to an auto default once a TPU
+        # run validates it.
         flash_min_len: int = 2048,  # engage flash only when the LOCAL
         # sequence chunk is at least this long (v5e measurement in
         # generation.py: XLA's fused attention wins below ~2k)
+        quantize: Optional[str] = None,  # None | int8 | w8a8 | int4 —
+        # quantized weights replicate over sp while the KV cache (the part
+        # that actually grows with context) stays sequence-sharded: the
+        # realistic long-context serving shape for 8B-class models.
+        # quantized_einsum dispatches on leaf names inside the shard_map,
+        # so every storage mode works unchanged.
     ):
         if mesh is None:
             mesh = make_mesh(
@@ -85,10 +94,28 @@ class SPGenerator:
         self.P = int(mesh.devices.size)
         self.cfg = cfg
         self.max_seq_length = int(min(max_seq_length or cfg.block_size, cfg.block_size))
+        from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, quantize_params
+
+        if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        if quantize in FLAG_TO_MODE:
+            params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
         if cache_dtype is None:
             cache_dtype = transformer.param_dtype(params)
         self.cache_dtype = cache_dtype
         self.decode_chunk = int(decode_chunk)
+        if use_flash and use_flash != "force" and jax.default_backend() != "tpu":
+            # fail soft, not with a raw Pallas lowering error mid-compile
+            # (matches Generator's auto gate and bench.run_prefill).
+            # use_flash="force" skips the gate (trace tests, interpret runs).
+            import sys
+
+            print(
+                "warning: --sp-flash needs a TPU backend; falling back to "
+                "the XLA ring-attention path",
+                file=sys.stderr,
+            )
+            use_flash = False
         self.use_flash = bool(use_flash)
         self.flash_min_len = int(flash_min_len)
         self.key = jax.random.PRNGKey(rng_seed)
@@ -99,6 +126,24 @@ class SPGenerator:
         )
         self._prefill_jit: Dict[Tuple, Any] = {}
         self._decode_jit: Dict[Tuple, Any] = {}
+        self._last_kp: Optional[np.ndarray] = None  # debug observable: the
+        # slot→position map after the most recent generate() (see
+        # slot_owner_map)
+
+    def slot_owner_map(self) -> Optional[np.ndarray]:
+        """Debug observable for the round-robin cache-append math: the
+        slot→absolute-position map after the most recent `generate`,
+        shaped (B, P, C) — entry [b, d, j] is the sequence position whose
+        K/V lives in device d's local slot j for sample b (POS_SENTINEL =
+        empty).  Slots j < Tl were written by prefill (device d's prompt
+        chunk); slots j >= Tl by decode step s = (j - Tl)·P + d, i.e.
+        owner d = s % P at local row Tl + s // P.  Tests assert this map
+        directly at the `new % P` boundaries so an owner-math regression
+        cannot hide behind tiny-model logit tolerance."""
+        if self._last_kp is None:
+            return None
+        B = self._last_kp.shape[0]
+        return self._last_kp.reshape(B, self.P, -1)
 
     # -- sharding specs ------------------------------------------------------
 
@@ -303,6 +348,7 @@ class SPGenerator:
                         )
                     )
         stats.interrupted = guard.interrupted
+        self._last_kp = np.asarray(kp)
         stats.decode_s = time.perf_counter() - t0 - stats.prefill_s
         trimmed = []
         for o, l in zip(out, lens):
@@ -310,3 +356,84 @@ class SPGenerator:
             trimmed.append(o[: l + cut])
         stats.tokens_generated = sum(len(o) - l for o, l in zip(out, lens))
         return trimmed, stats
+
+    def generate_chat(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ):
+        """Streaming single-sample generation over the sp mesh — same
+        contract as `Generator.generate_chat` (tokens yielded as sampled,
+        stop-sequence prefixes buffered so a partial marker never prints),
+        so the chat REPL drives long-context sequence-sharded serving the
+        same way it drives every other backend.  Tokens surface per decode
+        chunk (`decode_chunk`; pass a small one for lower time-to-first-
+        byte at a modest dispatch-rate cost)."""
+        from mdi_llm_tpu.generation import StopPrefixFilter
+
+        def _iter():
+            ready: List[int] = []
+            filt = StopPrefixFilter(stop_sequences, ready.append)
+            for t in self._generate_stream(
+                prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
+            ):
+                filt.push(t)
+                yield from ready
+                ready.clear()
+                if filt.stopped:
+                    return
+            filt.flush()
+            yield from ready
+
+        return _iter()
+
+    def _generate_stream(
+        self, prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
+    ):
+        Pn = self.P
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.max_seq_length:
+            raise ValueError(
+                f"prompt+generation length {len(prompt) + max_new_tokens} "
+                f"exceeds max_seq_length {self.max_seq_length}"
+            )
+        lens = jnp.asarray([len(prompt)], jnp.int32)
+        Tl = -(-_bucket(len(prompt)) // Pn)
+        C = Tl + -(-max_new_tokens // Pn)
+        toks_np = np.zeros((1, Tl * Pn), np.int32)
+        toks_np[0, : len(prompt)] = np.asarray(prompt, np.int32)
+
+        kv = self._init_kv(1, C)
+        prefill = self._get_prefill(1, Tl, C, temperature, top_k, top_p)
+        self.key, sub = jax.random.split(self.key)
+        kv, kp, tok = prefill(
+            self.params, self.rope, jnp.asarray(toks_np), lens, kv, sub
+        )
+        history = [int(np.asarray(tok)[0])]
+        yield history[0]
+        if detect_stop_tokens(history, stop_sequences):
+            return
+        n = 1
+        pos = lens
+        step0 = 0
+        while n < max_new_tokens:
+            c = min(self.decode_chunk, max_new_tokens - n)
+            decode = self._get_decode(1, Tl, C, c, temperature, top_k, top_p)
+            self.key, sub = jax.random.split(self.key)
+            kv, kp, tok, pos, toks = decode(
+                self.params, self.rope, kv, kp, tok, pos, jnp.int32(step0), sub
+            )
+            step0 += c
+            chunk = np.asarray(toks)
+            for i in range(c):
+                n += 1
+                t = int(chunk[i, 0])
+                history.append(t)
+                yield t
+                if detect_stop_tokens(history, stop_sequences):
+                    return

@@ -433,8 +433,9 @@ class Trainer:
 
             # the carry becomes device-varying after the first ppermute; a
             # fresh-zeros carry would type as unvarying and fail the scan
-            x0c = jax.lax.pvary(
-                jnp.zeros((mu, T, cfg.n_embd), emb_dtype), ("dp", "pp")
+            x0c = jax.lax.pcast(
+                jnp.zeros((mu, T, cfg.n_embd), emb_dtype), ("dp", "pp"),
+                to="varying",
             )
             _, emitted = jax.lax.scan(
                 step, x0c, jnp.arange(n_steps, dtype=jnp.int32)
@@ -444,12 +445,12 @@ class Trainer:
             logits = transformer.head(cfg, params, outs).astype(jnp.float32)
             losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             def psum_all(v):
-                # pvary exactly the axes the value does not already vary on
-                # (e.g. losses.size is a constant, invarying on both)
+                # cast-to-varying exactly the axes the value does not already
+                # vary on (e.g. losses.size is a constant, invarying on both)
                 have = getattr(jax.typeof(v), "vma", frozenset())
                 need = tuple(a for a in ("dp", "pp") if a not in have)
                 if need:
-                    v = jax.lax.pvary(v, need)
+                    v = jax.lax.pcast(v, need, to="varying")
                 return jax.lax.psum(v, ("dp", "pp"))
 
             is_last = (d == S - 1).astype(jnp.float32)
@@ -735,7 +736,16 @@ class Trainer:
         cfg = Config.from_checkpoint(out_dir)
         tc = TrainingConfig(**state["training_config"])
         with ocp.PyTreeCheckpointer() as ck:
-            params = ck.restore(out_dir / "params")
+            import warnings
+
+            with warnings.catch_warnings():
+                # orbax warns that sharding info comes from the file; the
+                # Trainer re-places every leaf onto its own mesh right after
+                # (device_put in __init__), so the notice is moot here
+                warnings.filterwarnings(
+                    "ignore", message=".*Sharding info not provided.*"
+                )
+                params = ck.restore(out_dir / "params")
         tr = cls(cfg, tc, mesh=mesh, params=params, out_dir=out_dir)
         raw = (out_dir / "opt_state.msgpack").read_bytes()
         if tr.pp:

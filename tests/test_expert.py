@@ -365,8 +365,13 @@ def test_trainer_ep_requires_moe():
 
 def test_ep_dispatch_splits_tokens_over_dp(devices):
     """With dp_axis, tokens split over dp×ep (each device routes N/(dp·ep))
-    and the result + aux still match the dense formulation."""
-    cfg = moe_config(E=4, k=2)
+    and the result + aux still match the dense formulation — and so do the
+    GRADIENTS (the configuration Trainer actually builds on a (dp, ep)
+    mesh; a wrong psum factor in the shard_map transpose over dp would
+    pass the forward checks and still let training loss decrease)."""
+    from mdi_llm_tpu.training import cross_entropy_loss
+
+    cfg = moe_config(E=4, k=2, n_layer=2)
     p = moe_layer_params(cfg, seed=11)
     mesh = make_mesh({"dp": 2, "ep": 4}, devices)
     x = jnp.asarray(
@@ -376,3 +381,32 @@ def test_ep_dispatch_splits_tokens_over_dp(devices):
     ye, aux_e = ep_moe_forward(cfg, p, x, mesh, with_aux=True, dp_axis="dp")
     np.testing.assert_allclose(np.asarray(ye), np.asarray(yd), atol=2e-5)
     np.testing.assert_allclose(float(aux_e), float(aux_d), rtol=1e-5)
+
+    params = init_params(cfg, jax.random.PRNGKey(13))
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), params)
+    rng = np.random.default_rng(14)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)), jnp.int32)
+    tgts = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)), jnp.int32)
+    impl = partial(
+        ep_moe_forward, mesh=mesh, capacity_factor=None, dp_axis="dp"
+    )
+    ld, gd = jax.value_and_grad(
+        lambda q: cross_entropy_loss(
+            cfg, q, toks, tgts, remat=False, moe_aux_weight=0.05
+        )
+    )(params)
+    le, ge = jax.value_and_grad(
+        lambda q: cross_entropy_loss(
+            cfg, q, toks, tgts, remat=False, moe_impl=impl, moe_aux_weight=0.05
+        )
+    )(params)
+    np.testing.assert_allclose(float(le), float(ld), rtol=2e-5)
+    for (k1, vd), (k2, ve) in zip(
+        jax.tree_util.tree_leaves_with_path(gd),
+        jax.tree_util.tree_leaves_with_path(ge),
+    ):
+        assert jax.tree_util.keystr(k1) == jax.tree_util.keystr(k2)
+        np.testing.assert_allclose(
+            np.asarray(ve), np.asarray(vd), atol=5e-5,
+            err_msg=f"dp-split grad mismatch at {jax.tree_util.keystr(k1)}",
+        )

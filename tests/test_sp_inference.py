@@ -97,10 +97,32 @@ def test_sp_cache_full_boundary(model, new, devices):
     sp = SPGenerator(cfg, params, devices=devices[:n_dev], cache_dtype=jnp.float32)
     got, _ = sp.generate(PROMPTS[:1], new, temperature=0.0)
     assert got == want
-    # (the `new` values are chosen BY CONSTRUCTION so the final round-robin
-    # write lands on/next to the last row of the C = Tl + ceil(new/P) shard
-    # budget — the token-parity assert above is what actually verifies the
-    # boundary behaved; there is no observable to assert on directly)
+    # direct observable (VERDICT r4 #6): the slot→position map must equal
+    # the round-robin owner math exactly — prefill slot j<Tl on device d
+    # holds gpos=d*Tl+j (or sentinel past the prompt); decode slot j>=Tl on
+    # device d holds position len + (j-Tl)*P + d for every step that ran
+    # (the final sampled token is never appended).  A regression in the
+    # owner/row arithmetic fails HERE, independent of logit tolerance.
+    from mdi_llm_tpu.parallel.sp_inference import POS_SENTINEL
+    from mdi_llm_tpu.generation import _bucket
+
+    kp = sp.slot_owner_map()
+    L = len(PROMPTS[0])
+    Tl = -(-_bucket(L) // n_dev)
+    C = Tl + -(-new // n_dev)
+    n_written = new - 1  # positions L .. L+new-2
+    want_map = np.full((n_dev, C), int(POS_SENTINEL), np.int64)
+    for d in range(n_dev):
+        for j in range(C):
+            if j < Tl:
+                gpos = d * Tl + j
+                if gpos < L:
+                    want_map[d, j] = gpos
+            else:
+                s = (j - Tl) * n_dev + d
+                if s < n_written:
+                    want_map[d, j] = L + s
+    np.testing.assert_array_equal(kp[0].astype(np.int64), want_map)
 
 
 def test_sp_mixed_length_batch(model, devices):
@@ -134,7 +156,7 @@ def test_sp_prefill_use_flash_traces_kernel(model, devices):
 
     sp = SPGenerator(
         cfg, params, devices=devices[:2], cache_dtype=jnp.float32,
-        use_flash=True, flash_min_len=8,
+        use_flash="force", flash_min_len=8,
     )
     assert "pallas_call" in trace(sp, 8)
     # same engine, chunk below the gate → XLA path
@@ -142,6 +164,12 @@ def test_sp_prefill_use_flash_traces_kernel(model, devices):
     # default stays off (opt-in until a real-TPU run validates the path)
     assert SPGenerator(
         cfg, params, devices=devices[:2], cache_dtype=jnp.float32
+    ).use_flash is False
+    # plain True soft-gates on the backend: no TPU here → warn + fall back
+    # instead of dying in Pallas lowering (ADVICE r4)
+    assert SPGenerator(
+        cfg, params, devices=devices[:2], cache_dtype=jnp.float32,
+        use_flash=True,
     ).use_flash is False
 
 
@@ -153,3 +181,41 @@ def test_sp_gqa_variant(devices):
     sp = SPGenerator(cfg, params, devices=devices[:4], cache_dtype=jnp.float32)
     got, _ = sp.generate([[4, 8, 15, 16, 23, 42]], 10, temperature=0.0)
     assert got == want
+
+
+def test_sp_quantized_decode_parity(model, devices):
+    """Quantized weights over an sp mesh (VERDICT r4 missing #4: int8
+    weights + sequence-sharded KV is the realistic long-context 8B serving
+    shape) reproduce single-device quantized greedy decode."""
+    cfg, params = model
+    single = Generator(cfg, params, cache_dtype=jnp.float32, quantize="int8")
+    want, _ = single.generate(PROMPTS, 10, temperature=0.0)
+    sp = SPGenerator(
+        cfg, params, devices=devices[:4], cache_dtype=jnp.float32,
+        quantize="int8",
+    )
+    got, _ = sp.generate(PROMPTS, 10, temperature=0.0)
+    assert got == want
+    # unknown mode still rejected
+    with pytest.raises(ValueError, match="quantize"):
+        SPGenerator(cfg, params, devices=devices[:2], quantize="int3")
+
+
+def test_sp_generate_chat_streams_same_tokens(model, devices):
+    """SPGenerator.generate_chat yields exactly the greedy generate() tail
+    (same contract as Generator.generate_chat), including stop filtering."""
+    cfg, params = model
+    prompt = [3, 1, 4, 1, 5]
+    sp = SPGenerator(
+        cfg, params, devices=devices[:4], cache_dtype=jnp.float32,
+        decode_chunk=3,  # force several chunked dispatches mid-stream
+    )
+    want, _ = sp.generate([prompt], 11, temperature=0.0)
+    got = list(sp.generate_chat(prompt, 11, temperature=0.0))
+    assert got == want[0][len(prompt):]
+
+    # stop sequences: the stream must cut exactly where generate() cuts
+    stop = [want[0][len(prompt) + 3 : len(prompt) + 5]]
+    want_stop, _ = sp.generate([prompt], 11, temperature=0.0, stop_sequences=stop)
+    got_stop = list(sp.generate_chat(prompt, 11, temperature=0.0, stop_sequences=stop))
+    assert got_stop == want_stop[0][len(prompt):]
