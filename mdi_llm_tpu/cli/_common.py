@@ -38,11 +38,12 @@ def resolve_kv_dtype(name: str):
 
 def make_tp_mesh(tp_devices: int, quantize: str):
     """Shared --tp-devices handling for the Generator entry points (sample,
-    chat): validate, then build a 1-D tp mesh over the first N devices."""
+    chat): validate, then build a 1-D tp mesh over the first N devices.
+    Composes with --quantize: quantized storage layouts shard under the
+    adapted Megatron specs (parallel/sharding.adapt_specs_to_tree)."""
+    del quantize  # accepted everywhere since r5; kept for call compatibility
     if tp_devices < 1:
         raise SystemExit("--tp-devices must be a positive device count")
-    if quantize not in (None, "none"):
-        raise SystemExit("--quantize is not supported with --tp-devices yet")
     import jax
 
     from mdi_llm_tpu.parallel.mesh import make_mesh

@@ -152,8 +152,6 @@ def main(argv=None):
             "--ep-devices is a standalone expert-parallel mesh; drop the "
             "other parallelism flags"
         )
-    if args.tp_devices > 1 and args.pipeline_stages and args.quantize not in (None, "none"):
-        raise SystemExit("--quantize is not supported on a pipe x tp mesh yet")
     seq_len = args.sequence_length
 
     from mdi_llm_tpu.utils.profiling import profile

@@ -114,6 +114,24 @@ class StopPrefixFilter:
             self.emitted += 1
 
 
+def stop_filtered_stream(raw_stream, stop_sequences):
+    """Wrap a raw sampled-token iterator with StopPrefixFilter semantics:
+    yield tokens as they clear the hold-back window, end at a stop without
+    ever emitting any part of the marker, flush the tail at exhaustion.
+    The single implementation of the streaming stop contract, shared by
+    every generate_chat backend (single-device/tp, sp)."""
+    ready: List[int] = []
+    filt = StopPrefixFilter(stop_sequences, ready.append)
+    for t in raw_stream:
+        filt.push(t)
+        yield from ready
+        ready.clear()
+        if filt.stopped:
+            return
+    filt.flush()
+    yield from ready
+
+
 class StreamPrinter:
     """Incremental console printer for a token stream, shared by the chat
     and starter CLIs: stop-prefix hold-back (StopPrefixFilter) plus
@@ -290,22 +308,14 @@ class Generator:
         if mesh is not None:
             from mdi_llm_tpu.ops.quant import tree_has_quantized
 
-            # guard BEFORE the (possibly minutes-long) host-side
-            # quantization of a large tree: it only needs mesh.shape + cfg.
             # Structural check, not just the flag: a pre-quantized
             # checkpoint (prepare_model --quantize) loads with
-            # quantize='none' but its tree still has weight_q/scale leaves
+            # quantize='none' but its tree still has weight_q/scale leaves.
+            # Quantized trees shard fine on tp/dp meshes — the standard
+            # Megatron specs adapt to the storage layouts
+            # (sharding.adapt_specs_to_tree); ep-MoE meshes use the
+            # positional expert placement below.
             quantized = quantized or tree_has_quantized(params)
-            if quantized and (tp_n > 1 or not ep_moe):
-                # ep-only (± dp) quantized MoE is supported below: experts
-                # shard by their leading axis regardless of leaf names, and
-                # everything else replicates.  tp sharding would need
-                # quantized-aware Megatron specs, which don't exist.
-                raise ValueError(
-                    "quantized trees use custom leaf names the GSPMD sharding "
-                    "rules don't cover; drop the mesh/tp or the quantization "
-                    "(expert-parallel MoE meshes are the exception)"
-                )
         if quantize in FLAG_TO_MODE:
             from mdi_llm_tpu.ops.quant import quantize_params
 
@@ -337,12 +347,14 @@ class Generator:
                     axis="ep",
                     capacity_factor=moe_capacity_factor,
                 )
-            if quantized:
+            if quantized and ep_moe:
                 # name-agnostic placement: leaves under an "experts" subtree
                 # shard their (layer, expert, ...) expert axis over ep (this
                 # covers weight_q/scale layouts too); all else replicates
                 params = _place_ep_quantized(params, mesh, cfg.n_expert)
             else:
+                # standard Megatron layout; quantized storage layouts map
+                # onto it name-agnostically (adapt_specs_to_tree)
                 params = shard_params(
                     params, cfg, mesh, "tp" if tp_n > 1 else None, ep_axis
                 )
@@ -751,22 +763,12 @@ class Generator:
         # next(), after the caller may already be streaming
         if self._dp > 1:
             raise ValueError("streaming generates one sample; use a tp-only mesh")
-
-        def _iter():
-            ready: List[int] = []
-            filt = StopPrefixFilter(stop_sequences, ready.append)
-            for t in self._generate_stream(
+        return stop_filtered_stream(
+            self._generate_stream(
                 prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
-            ):
-                filt.push(t)
-                yield from ready
-                ready.clear()
-                if filt.stopped:
-                    return
-            filt.flush()
-            yield from ready
-
-        return _iter()
+            ),
+            stop_sequences,
+        )
 
     def _generate_stream(self, prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences):
         lens = len(prompt)

@@ -116,17 +116,6 @@ class PipelineEngine:
         self.n_stages = S
         self.tp = int(mesh.shape.get("tp", 1))
         validate_tp_divisibility(cfg, self.tp)
-        if self.tp > 1:
-            from mdi_llm_tpu.ops.quant import tree_has_quantized
-
-            # structural check, not just the flag: a pre-quantized
-            # checkpoint loads with quantize='none' but still has
-            # weight_q/scale leaves the tp specs can't map
-            if quantize not in (None, "none") or tree_has_quantized(params):
-                raise ValueError(
-                    "quantized trees use custom leaf names the tp sharding "
-                    "rules don't cover; drop tp or the quantization"
-                )
         if quantize in FLAG_TO_MODE:
             params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
         if cache_dtype is None:
@@ -146,10 +135,18 @@ class PipelineEngine:
         if self.tp > 1:
             # stage axis manual over "pipe"; weight dims additionally laid
             # out under the Megatron specs so GSPMD (tp is an auto axis of
-            # the ring shard_map) inserts the all-reduces within each stage
-            from mdi_llm_tpu.parallel.sharding import param_specs
+            # the ring shard_map) inserts the all-reduces within each stage.
+            # Quantized storage layouts map onto the same specs name-
+            # agnostically (leading_axes=1 accounts for the stage axis)
+            from mdi_llm_tpu.parallel.sharding import (
+                adapt_specs_to_tree,
+                param_specs,
+            )
 
-            bspecs = param_specs(cfg, "tp")["blocks"]
+            bspecs = adapt_specs_to_tree(
+                param_specs(cfg, "tp")["blocks"], blocks_np, leading_axes=1,
+                axis_sizes={"tp": self.tp},
+            )
             self.stage_blocks = jax.tree_util.tree_map(
                 lambda a, s: jax.device_put(
                     a, NamedSharding(mesh, P("pipe", *s))

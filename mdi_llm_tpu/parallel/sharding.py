@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -119,6 +120,67 @@ def validate_tp_divisibility(cfg: Config, tp: int, check_vocab: bool = False):
         raise ValueError(f"tp={tp} does not divide {', '.join(bad)} of {cfg.name}")
 
 
+def adapt_specs_to_tree(
+    specs: Any,
+    params: Any,
+    leading_axes: int = 0,
+    axis_sizes: Optional[Dict[str, int]] = None,
+):
+    """Adapt a `param_specs` tree (standard "weight" leaves) to the ACTUAL
+    params tree, which may hold quantized storage layouts
+    (weight_q/weight_q8 int8, weight_q4 packed nibbles, + scale —
+    ops/quant.py).  The quantized layouts keep the weight's axis order, so
+    one rule covers every mode:
+
+    - `weight_q*` inherits the weight's spec unchanged (the int4 packed
+      axis is still the contracted input axis — same sharding);
+    - `scale` inherits the FIRST `ndim` entries of the weight's spec:
+      per-out-channel scales (L, out) follow the out-dim sharding of
+      column-parallel weights and replicate for row-parallel ones (where
+      the weight spec's entry 1 is None), while int4 group scales
+      (L, out, groups) additionally shard their group axis exactly when
+      the contracted axis is sharded.
+
+    `leading_axes` accounts for extra stacked axes the caller prepends to
+    every leaf (the pipeline's stage axis): scale truncation then uses
+    `leaf.ndim - leading_axes`.  `axis_sizes` (mesh axis name → size)
+    un-shards any scale dim the mesh cannot divide — the int4 group axis
+    collapses to a single group whenever the input dim is <= the group
+    width (w4_group_size), and a size-1 dim cannot shard; the matmul stays
+    exact either way, the spec is only a layout.
+    """
+
+    def scale_spec(base, v):
+        entries = list(base[: np.ndim(v) - leading_axes])
+        if axis_sizes:
+            shape = np.shape(v)[leading_axes:]
+            entries = [
+                a
+                if a is None or shape[i] % axis_sizes.get(a, 1) == 0
+                else None
+                for i, a in enumerate(entries)
+            ]
+        return P(*entries)
+
+    def walk(s_node, p_node):
+        if not isinstance(p_node, dict):
+            return s_node
+        if any(k.startswith("weight_q") for k in p_node):
+            base = s_node["weight"]
+            out = {}
+            for k, v in p_node.items():
+                if k == "scale":
+                    out[k] = scale_spec(base, v)
+                elif k.startswith("weight_q"):
+                    out[k] = base
+                else:  # bias etc. keep their standard spec
+                    out[k] = s_node[k]
+            return out
+        return {k: walk(s_node[k], v) for k, v in p_node.items()}
+
+    return walk(specs, params)
+
+
 def shard_params(
     params: Any,
     cfg: Config,
@@ -126,10 +188,13 @@ def shard_params(
     tp_axis: Optional[str] = "tp",
     ep_axis: Optional[str] = None,
 ):
-    """Place a params pytree onto `mesh` under the TP/EP rules."""
+    """Place a params pytree onto `mesh` under the TP/EP rules.  Quantized
+    trees (weight_q/scale leaves) are handled by adapting the standard
+    specs to the storage layout — see `adapt_specs_to_tree`."""
     tp = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
     ep = ep_axis if (ep_axis and ep_axis in mesh.axis_names) else None
-    specs = param_specs(cfg, tp, ep)
+    sizes = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+    specs = adapt_specs_to_tree(param_specs(cfg, tp, ep), params, axis_sizes=sizes)
     return jax.tree_util.tree_map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
     )

@@ -373,23 +373,14 @@ class SPGenerator:
         same way it drives every other backend.  Tokens surface per decode
         chunk (`decode_chunk`; pass a small one for lower time-to-first-
         byte at a modest dispatch-rate cost)."""
-        from mdi_llm_tpu.generation import StopPrefixFilter
+        from mdi_llm_tpu.generation import stop_filtered_stream
 
-        def _iter():
-            ready: List[int] = []
-            filt = StopPrefixFilter(stop_sequences, ready.append)
-            for t in self._generate_stream(
+        return stop_filtered_stream(
+            self._generate_stream(
                 prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
-            ):
-                filt.push(t)
-                yield from ready
-                ready.clear()
-                if filt.stopped:
-                    return
-            filt.flush()
-            yield from ready
-
-        return _iter()
+            ),
+            stop_sequences,
+        )
 
     def _generate_stream(
         self, prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences

@@ -216,6 +216,21 @@ def test_chat_cli_tp_mesh(tiny_ckpt, monkeypatch, capsys):
     assert "Chatting with" in capsys.readouterr().out
 
 
+def test_sample_cli_tp_quantized(tiny_ckpt, devices):
+    """--tp-devices composes with --quantize through the CLI (the pre-r5
+    make_tp_mesh guard is gone): same tokens as single-device quantized."""
+    from mdi_llm_tpu.cli.sample import main
+
+    common = [
+        "--ckpt", str(tiny_ckpt), "--dtype", "float32", "--n-samples", "2",
+        "--n-tokens", "5", "--prompt", "lazy dog runs", "--greedy",
+        "--quantize", "int8",
+    ]
+    single_q = main(common)
+    tp_q = main(common + ["--tp-devices", "2"])
+    assert tp_q == single_q
+
+
 def test_chat_cli_sp_mesh(tiny_ckpt, monkeypatch, capsys):
     """Streaming chat over a 2-way sequence-parallel mesh (VERDICT r4
     missing #3: chat could not drive the sp backend), plus quantize —

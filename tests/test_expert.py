@@ -205,14 +205,17 @@ def test_generator_ep_prequantized_tree(devices):
     eng = Generator(cfg, qp, max_seq_length=64, mesh=mesh)
     outs, _ = eng.generate([[2, 4, 6]], 6, temperature=0.0)
     assert len(outs[0]) == 9
-    # and quantized + tp still raises (no Megatron specs for weight_q)
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError, match="quantized trees"):
-        Generator(
-            cfg, qp, max_seq_length=64,
-            mesh=make_mesh({"tp": 2}, jax.devices()[:2]),
-        )
+    # quantized + tp now shards through the adapted Megatron specs (the
+    # pre-r5 reject is gone): same tokens, experts sharded over tp
+    ref, _ = Generator(cfg, qp, max_seq_length=64).generate(
+        [[2, 4, 6]], 6, temperature=0.0
+    )
+    tp_eng = Generator(
+        cfg, qp, max_seq_length=64,
+        mesh=make_mesh({"tp": 2}, jax.devices()[:2]),
+    )
+    got, _ = tp_eng.generate([[2, 4, 6]], 6, temperature=0.0)
+    assert got == ref
 
 
 def test_generator_ep_decode_parity(devices):

@@ -309,15 +309,25 @@ def test_pipeline_tp_samples_per_slot(model, single_engine, devices):
     assert got == want
 
 
-def test_pipeline_tp_rejects_quantize(model, devices):
-    """The guard must trigger on the MESH-derived tp (an explicit tp mesh
-    without the tp= argument is the established construction pattern)."""
+def test_pipeline_tp_quantized_parity(model, devices):
+    """pipe x tp with int8 weights (pre-r5 this was rejected): the stage
+    blocks' quantized leaves lay out under the adapted Megatron specs and
+    generation matches the single-device quantized engine."""
     cfg, params = model
-    with pytest.raises(ValueError, match="quantized"):
-        PipelineEngine(
-            cfg, params, mesh=pipeline_mesh(2, devices[:4], tp=2),
-            quantize="int8",
-        )
+    from mdi_llm_tpu.generation import Generator
+
+    single_q = Generator(cfg, params, cache_dtype=jnp.float32, quantize="int8")
+    want, _ = single_q.generate(PROMPTS[:2], 10, temperature=0.0)
+    eng = PipelineEngine(
+        cfg, params, mesh=pipeline_mesh(2, devices[:4], tp=2),
+        cache_dtype=jnp.float32, quantize="int8",
+    )
+    got, _ = eng.generate(PROMPTS[:2], 10, temperature=0.0)
+    assert got == want
+    # col-parallel weight_q sharded over tp; its scale follows the out dim
+    qkv = eng.stage_blocks["attn"]["qkv"]
+    assert "tp" in str(qkv["weight_q"].sharding.spec)
+    assert "tp" in str(qkv["scale"].sharding.spec)
 
 
 @pytest.mark.parametrize("overlap", [True, False])
