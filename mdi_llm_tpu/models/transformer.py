@@ -93,7 +93,8 @@ def mlp_forward(
 
 
 def moe_forward(
-    cfg: Config, p: Params, x: jnp.ndarray, with_aux: bool = False
+    cfg: Config, p: Params, x: jnp.ndarray, with_aux: bool = False,
+    stats_reduce=None,
 ):
     """Top-k routed mixture of experts (reference `LLaMAMoE`,
     model.py:823-853).
@@ -112,6 +113,13 @@ def moe_forward(
     Transformer).  The reference trains its MoE with no balancing term
     (model.py:823-853); this is the TPU-first addition that keeps
     sharded-expert training balanced.
+
+    `stats_reduce` (used inside shard_map losses, e.g. sp training where
+    each device routes only its sequence chunk) reduces the raw per-expert
+    sums `(assign, prob_sum, n_tokens)` across devices — typically
+    `lambda t: jax.lax.psum(t, axes)` — BEFORE the aux is formed, so the
+    result is the exact global formula rather than a mean of per-chunk
+    auxes (f·P is nonlinear in the stats).
     """
     E = cfg.n_expert
     router = quantized_einsum("...i,ei->...e", x, p["gate"]).astype(jnp.float32)
@@ -131,12 +139,15 @@ def moe_forward(
     if not with_aux:
         return y
     k = cfg.n_expert_per_token
-    n_tokens = probs.size // E
     assign = jnp.sum(
         jax.lax.stop_gradient(onehot).reshape(-1, E), axis=0
-    )  # (E,) top-k assignment counts
-    f = assign / jnp.asarray(n_tokens * k, jnp.float32)
-    pm = jnp.mean(probs.reshape(-1, E), axis=0)
+    ).astype(jnp.float32)  # (E,) top-k assignment counts (sum over k too)
+    prob_sum = jnp.sum(probs.reshape(-1, E), axis=0)
+    n_tokens = jnp.asarray(probs.size // E, jnp.float32)
+    if stats_reduce is not None:
+        assign, prob_sum, n_tokens = stats_reduce((assign, prob_sum, n_tokens))
+    f = assign / (n_tokens * k)
+    pm = prob_sum / n_tokens
     return y, E * jnp.sum(f * pm)
 
 

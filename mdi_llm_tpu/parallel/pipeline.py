@@ -97,8 +97,10 @@ class PipelineEngine:
 
         if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
             raise ValueError(f"unknown quantize mode {quantize!r}")
-        # derive the effective mesh/tp BEFORE quantizing: the quantize-vs-tp
-        # guard must see the mesh-derived tp, not just the tp argument
+        # derive the effective mesh/tp before quantizing: the stage-block
+        # placement below adapts the Megatron specs to the quantized
+        # storage layout (sharding.adapt_specs_to_tree) using mesh-derived
+        # sizes
         if mesh is None:
             n_dev = len(devices or jax.devices())
             if tp < 1:

@@ -150,7 +150,11 @@ def adapt_specs_to_tree(
     exact either way, the spec is only a layout.
     """
 
-    def scale_spec(base, v):
+    def fit_spec(base, v):
+        """`base` truncated to the leaf's dims, with any mesh-indivisible
+        sharding dropped (applies to weight_q4 too: its packed axis is
+        HALF the input dim, so a tp-divisible input dim does not guarantee
+        a tp-divisible packed axis)."""
         entries = list(base[: np.ndim(v) - leading_axes])
         if axis_sizes:
             shape = np.shape(v)[leading_axes:]
@@ -169,10 +173,8 @@ def adapt_specs_to_tree(
             base = s_node["weight"]
             out = {}
             for k, v in p_node.items():
-                if k == "scale":
-                    out[k] = scale_spec(base, v)
-                elif k.startswith("weight_q"):
-                    out[k] = base
+                if k == "scale" or k.startswith("weight_q"):
+                    out[k] = fit_spec(base, v)
                 else:  # bias etc. keep their standard spec
                     out[k] = s_node[k]
             return out

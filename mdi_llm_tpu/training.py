@@ -273,15 +273,16 @@ class Trainer:
         self._moe_aux_w = (
             tc.moe_aux_weight if cfg.mlp_class_name == "LLaMAMoE" else 0.0
         )
-        if self._moe_aux_w and (self.sp or self.pp):
-            # the sp/pp loss functions run their own shard_map ring and do
-            # not thread the per-layer aux accumulator; training proceeds as
-            # pure CE there (the reference's behavior) — say so rather than
-            # silently dropping the term the config promises
+        if self._moe_aux_w and self.pp:
+            # the pp ring scans stage-sharded blocks and does not thread the
+            # per-layer aux accumulator; training proceeds as pure CE there
+            # (the reference's behavior) — say so rather than silently
+            # dropping the term the config promises.  (sp DOES apply it:
+            # _sp_loss_fn psums the router stats across the mesh.)
             import sys
 
             print(
-                "warning: moe_aux_weight is not applied on sp/pp training "
+                "warning: moe_aux_weight is not applied on pp training "
                 "meshes (MoE trains dense, pure CE there); set "
                 "moe_aux_weight=0 to silence",
                 file=sys.stderr,
@@ -354,22 +355,47 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
-    def _sp_loss_fn(self):
+    def _sp_loss_fn(self, aux_w: Optional[float] = None):
         """Sequence-parallel loss: shard_map over (dp, sp); each device holds
         a sequence chunk, attention rides the ring (ops.ring_attention), the
         scalar loss is psum-reduced.  jax.grad differentiates through the
-        shard_map (psum transposes handled by JAX)."""
+        shard_map (psum transposes handled by JAX).
+
+        MoE configs additionally apply the load-balancing aux loss: each
+        device routes only its chunk, so the raw router stats psum across
+        (dp, sp) BEFORE the aux is formed (`moe_forward(stats_reduce=...)`)
+        — the exact global formula, not a mean of per-chunk auxes."""
         cfg, tc, mesh = self.cfg, self.tc, self.mesh
 
         use_flash = self.use_flash
+        aux_w = self._moe_aux_w if aux_w is None else aux_w
+
+        def psum_vary(t):
+            # cast-to-varying whatever doesn't already vary (the static
+            # token count), then reduce — same pattern as the pp psums
+            def cast(v):
+                have = getattr(jax.typeof(v), "vma", frozenset())
+                need = tuple(a for a in ("dp", "sp") if a not in have)
+                return jax.lax.pcast(v, need, to="varying") if need else v
+
+            return jax.lax.psum(jax.tree_util.tree_map(cast, t), ("dp", "sp"))
+
+        collect = aux_w > 0
+        moe_impl = (
+            partial(transformer.moe_forward, stats_reduce=psum_vary)
+            if collect
+            else None
+        )
 
         def local_loss(params, x, y):
             start = jax.lax.axis_index("sp") * x.shape[1]
             input_pos = jnp.full((x.shape[0],), start, jnp.int32)
-            logits, _ = transformer.forward(
+            out = transformer.forward(
                 cfg, params, x, input_pos, remat=tc.remat, sp_axis="sp",
-                use_flash=use_flash,
+                use_flash=use_flash, moe_impl=moe_impl,
+                collect_moe_aux=collect,
             )
+            logits = out[0]
             losses = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), y
             )
@@ -377,7 +403,10 @@ class Trainer:
             count = jax.lax.psum(
                 jnp.asarray(losses.size, jnp.float32), ("dp", "sp")
             )
-            return total / count
+            loss = total / count
+            if collect:
+                loss = loss + aux_w * out[2] / cfg.n_layer
+            return loss
 
         repl = jax.tree_util.tree_map(lambda _: P(), self.params)
         return jax.shard_map(
@@ -527,7 +556,8 @@ class Trainer:
         if self.pp:
             ev = self._pp_loss_fn()
         elif self.sp:
-            ev = self._sp_loss_fn()
+            # eval stays pure CE (same reasoning as the default branch)
+            ev = self._sp_loss_fn(aux_w=0.0)
         else:
 
             def ev(params, x, y):
