@@ -291,11 +291,21 @@ class Trainer:
         self.dp_axis: Optional[str] = "dp"
         if self.pp:
             # GPipe-style pipeline-parallel training over a ("dp", "pp")
-            # mesh: stage-sharded blocks, microbatched ring forward
-            if self.sp or "tp" in mesh.axis_names:
-                raise ValueError("pp composes with dp only (pp×tp/sp: future work)")
+            # (optionally ×"tp") mesh: stage-sharded blocks, microbatched
+            # ring forward.  tp mirrors the inference pipe×tp trick
+            # (parallel/pipeline.py): the ring shard_map is manual over
+            # dp/pp only, the stage matmuls additionally carry Megatron
+            # shardings on the auto tp axis and GSPMD inserts the
+            # within-stage all-reduces over ICI — 3D (dp, pp, tp) training
+            if self.sp:
+                raise ValueError("pp composes with dp/tp only (pp×sp: future work)")
             S = int(mesh.shape["pp"])
             self.pp_stages = S
+            self.pp_tp = int(mesh.shape.get("tp", 1))
+            if self.pp_tp > 1:
+                from mdi_llm_tpu.parallel.sharding import validate_tp_divisibility
+
+                validate_tp_divisibility(cfg, self.pp_tp)
             # balanced split (NOT the inference table): the training ring
             # runs embed+head on every stage anyway, and every stage scans
             # l_max layers per micro-step — padded layers cost full FLOPs,
@@ -319,9 +329,17 @@ class Trainer:
                     pp_params[k] = params[k]
             params = jax.tree_util.tree_map(jnp.asarray, pp_params)
             pspecs = jax.tree_util.tree_map(lambda _: P(), params)
-            pspecs["stage_blocks"] = jax.tree_util.tree_map(
-                lambda _: P("pp"), params["stage_blocks"]
-            )
+            if self.pp_tp > 1:
+                # stage axis + Megatron layout within each stage (leaf
+                # shapes: (S, L_stage, ...) → P("pp", *block_spec))
+                bspecs = param_specs(cfg, "tp")["blocks"]
+                pspecs["stage_blocks"] = jax.tree_util.tree_map(
+                    lambda _, s: P("pp", *s), params["stage_blocks"], bspecs
+                )
+            else:
+                pspecs["stage_blocks"] = jax.tree_util.tree_map(
+                    lambda _: P("pp"), params["stage_blocks"]
+                )
             self.param_shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s), pspecs
             )
@@ -427,10 +445,18 @@ class Trainer:
         through the scan and ppermute (transpose = reverse permute), giving
         the 1F1B-equivalent backward for free.  Zero-padded stage layers are
         exact identities and receive zero gradients, and AdamW keeps them at
-        zero (masked decay, zero moments)."""
+        zero (masked decay, zero moments).
+
+        With a "tp" mesh axis the ring is manual over (dp, pp) only: the
+        stage blocks carry Megatron shardings on the auto tp axis, GSPMD
+        inserts the within-stage all-reduces (same construction as the
+        inference pipe×tp ring, parallel/pipeline.py) — vma checking is
+        unavailable in partial-auto mode, so the pcast bookkeeping below
+        only runs in the fully-manual case."""
         cfg, tc, mesh = self.cfg, self.tc, self.mesh
         S = self.pp_stages
         n_micro = S
+        manual_vma = self.pp_tp == 1
 
         def local_loss(params, x, y):
             blocks = jax.tree_util.tree_map(
@@ -462,10 +488,9 @@ class Trainer:
 
             # the carry becomes device-varying after the first ppermute; a
             # fresh-zeros carry would type as unvarying and fail the scan
-            x0c = jax.lax.pcast(
-                jnp.zeros((mu, T, cfg.n_embd), emb_dtype), ("dp", "pp"),
-                to="varying",
-            )
+            x0c = jnp.zeros((mu, T, cfg.n_embd), emb_dtype)
+            if manual_vma:
+                x0c = jax.lax.pcast(x0c, ("dp", "pp"), to="varying")
             _, emitted = jax.lax.scan(
                 step, x0c, jnp.arange(n_steps, dtype=jnp.int32)
             )
@@ -476,10 +501,11 @@ class Trainer:
             def psum_all(v):
                 # cast-to-varying exactly the axes the value does not already
                 # vary on (e.g. losses.size is a constant, invarying on both)
-                have = getattr(jax.typeof(v), "vma", frozenset())
-                need = tuple(a for a in ("dp", "pp") if a not in have)
-                if need:
-                    v = jax.lax.pcast(v, need, to="varying")
+                if manual_vma:
+                    have = getattr(jax.typeof(v), "vma", frozenset())
+                    need = tuple(a for a in ("dp", "pp") if a not in have)
+                    if need:
+                        v = jax.lax.pcast(v, need, to="varying")
                 return jax.lax.psum(v, ("dp", "pp"))
 
             is_last = (d == S - 1).astype(jnp.float32)
@@ -491,11 +517,16 @@ class Trainer:
         pspec["stage_blocks"] = jax.tree_util.tree_map(
             lambda _: P("pp"), self.params["stage_blocks"]
         )
+        kwargs = {}
+        if not manual_vma:
+            # manual over the dp/pp ring only; "tp" stays an auto GSPMD axis
+            kwargs = {"axis_names": {"dp", "pp"}, "check_vma": False}
         return jax.shard_map(
             local_loss,
             mesh=mesh,
             in_specs=(pspec, P("dp"), P("dp")),
             out_specs=P(),
+            **kwargs,
         )
 
     def _build_step(self):

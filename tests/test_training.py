@@ -94,14 +94,19 @@ def test_mesh_training_matches_single_device(axes, devices):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("axes", [{"dp": 1, "pp": 2}, {"dp": 2, "pp": 4}])
+@pytest.mark.parametrize(
+    "axes",
+    [{"dp": 1, "pp": 2}, {"dp": 2, "pp": 4}, {"dp": 2, "pp": 2, "tp": 2}],
+)
 def test_pp_training_matches_single_device(axes, devices):
     """GPipe pipeline-parallel training (stage-sharded blocks, microbatched
     ring) must produce the same params as unsharded training — the padded
-    stage layers are exact identities and stay zero through AdamW."""
+    stage layers are exact identities and stay zero through AdamW.  The
+    third case is 3D dp×pp×tp: ring manual over dp/pp, Megatron-sharded
+    stage matmuls on the auto tp axis."""
     cfg = tiny_config(block_size=16, n_layer=5)
     data = toy_data(1024)
-    n_dev = axes["dp"] * axes["pp"]
+    n_dev = int(np.prod(list(axes.values())))
     batch = max(4, n_dev)  # each dp shard must split into pp microbatches
 
     def run(mesh):
