@@ -229,6 +229,12 @@ class Trainer:
                 "mesh: GSPMD cannot partition the Pallas flash call; drop "
                 "the mesh, use an sp mesh, or set use_flash=False/None"
             )
+        if self.use_flash and sp_mesh and "tp" in mesh.axis_names:
+            raise ValueError(
+                "use_flash sp training does not compose with a tp axis: "
+                "the Pallas call would sit on the auto tp axis, which "
+                "GSPMD cannot partition; drop tp or use_flash"
+            )
         dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[tc.dtype]
 
         key = jax.random.PRNGKey(tc.seed)
@@ -348,9 +354,15 @@ class Trainer:
             )
             self.batch_sharding = NamedSharding(mesh, P("dp"))
         elif mesh is not None:
-            # sequence parallelism uses explicit shard_map collectives; params
-            # stay replicated there (tp+sp composition is future work)
-            tp = "tp" if ("tp" in mesh.axis_names and not self.sp) else None
+            # sequence parallelism uses explicit shard_map collectives over
+            # (dp, sp); a tp axis composes the same way as pp×tp — the ring
+            # stays manual, params carry Megatron shardings on the auto tp
+            # axis and GSPMD all-reduces within each sequence chunk
+            tp = "tp" if "tp" in mesh.axis_names else None
+            if tp:
+                from mdi_llm_tpu.parallel.sharding import validate_tp_divisibility
+
+                validate_tp_divisibility(cfg, int(mesh.shape["tp"]))
             pspecs = param_specs(cfg, tp, ep_axis="ep" if self.ep else None)
             self.param_shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s), pspecs
@@ -387,11 +399,16 @@ class Trainer:
 
         use_flash = self.use_flash
         aux_w = self._moe_aux_w if aux_w is None else aux_w
+        # with a tp axis the ring is manual over (dp, sp) only and vma
+        # checking is unavailable (same partial-auto construction as pp×tp)
+        manual_vma = int(mesh.shape.get("tp", 1)) == 1
 
         def psum_vary(t):
             # cast-to-varying whatever doesn't already vary (the static
             # token count), then reduce — same pattern as the pp psums
             def cast(v):
+                if not manual_vma:
+                    return v
                 have = getattr(jax.typeof(v), "vma", frozenset())
                 need = tuple(a for a in ("dp", "sp") if a not in have)
                 return jax.lax.pcast(v, need, to="varying") if need else v
@@ -427,11 +444,15 @@ class Trainer:
             return loss
 
         repl = jax.tree_util.tree_map(lambda _: P(), self.params)
+        kwargs = {}
+        if not manual_vma:
+            kwargs = {"axis_names": {"dp", "sp"}, "check_vma": False}
         return jax.shard_map(
             local_loss,
             mesh=mesh,
             in_specs=(repl, P("dp", "sp"), P("dp", "sp")),
             out_specs=P(),
+            **kwargs,
         )
 
     def _pp_loss_fn(self):
