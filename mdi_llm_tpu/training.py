@@ -41,7 +41,7 @@ from jax.sharding import PartitionSpec as P
 from mdi_llm_tpu.config import Config
 from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.parallel.partition import pad_stage_blocks, unpad_stage_blocks
-from mdi_llm_tpu.parallel.sharding import param_specs
+from mdi_llm_tpu.parallel.sharding import param_specs, validate_tp_divisibility
 from mdi_llm_tpu.utils import data_loader
 
 
@@ -309,8 +309,6 @@ class Trainer:
             self.pp_stages = S
             self.pp_tp = int(mesh.shape.get("tp", 1))
             if self.pp_tp > 1:
-                from mdi_llm_tpu.parallel.sharding import validate_tp_divisibility
-
                 validate_tp_divisibility(cfg, self.pp_tp)
             # balanced split (NOT the inference table): the training ring
             # runs embed+head on every stage anyway, and every stage scans
@@ -360,8 +358,6 @@ class Trainer:
             # axis and GSPMD all-reduces within each sequence chunk
             tp = "tp" if "tp" in mesh.axis_names else None
             if tp:
-                from mdi_llm_tpu.parallel.sharding import validate_tp_divisibility
-
                 validate_tp_divisibility(cfg, int(mesh.shape["tp"]))
             pspecs = param_specs(cfg, tp, ep_axis="ep" if self.ep else None)
             self.param_shardings = jax.tree_util.tree_map(
