@@ -95,9 +95,19 @@ def build_parser():
         "steady-state ring rotations per jit call, default 16)",
     )
     ap.add_argument(
-        "--mode", choices=("decode", "prefill"), default="decode",
+        "--mode", choices=("decode", "prefill", "train"), default="decode",
         help="prefill: compare flash-attention prefill latency vs the XLA "
-        "path at --prompt-len and verify greedy-token agreement",
+        "path at --prompt-len and verify greedy-token agreement; "
+        "train: time optimizer steps on synthetic data (tokens/s + MFU) — "
+        "on TPU with --seq-len >= 2048 this exercises the Pallas flash "
+        "custom_vjp forward+backward on hardware",
+    )
+    ap.add_argument("--train-steps", type=int, default=6,
+                    help="train mode: timed optimizer steps (after 1 warmup)")
+    ap.add_argument(
+        "--train-flash", choices=("auto", "on", "off"), default="auto",
+        help="train mode: force the flash-attention training path on/off "
+        "(auto = Trainer's backend/seq-len gate)",
     )
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="direct mode: wrap the timed run in a jax.profiler trace")
@@ -127,6 +137,84 @@ def run_probe():
         "unit": "s",
         "vs_baseline": 1.0,
         "detail": {"backend": jax.default_backend(), "device": str(devs[0])},
+    }
+
+
+def run_train(args):
+    """Timed optimizer steps on synthetic tokens: tokens/s/chip + MFU.
+
+    The single-chip hardware validation path for the flash-attention
+    training kernel (`ops/flash.py` custom_vjp): an unmeshed Trainer on
+    TPU with block_size >= 2048 auto-engages flash for both the forward
+    and the FA-2 recompute backward, so one green run of
+    ``bench.py --direct --mode train --seq-len 2048`` IS the flash-VJP
+    on-hardware proof (compare --train-flash on/off for the crossover).
+    MFU baseline 1.0 = the v5e bf16 peak (~197 TFLOP/s); vs_baseline
+    reports the measured model-FLOPs utilization.
+    """
+    import jax
+    import numpy as np
+
+    from mdi_llm_tpu.config import Config
+    from mdi_llm_tpu.training import (
+        Trainer, TrainingConfig, estimate_flops_per_token,
+    )
+
+    cfg = Config.from_name(args.model)
+    use_flash = {"auto": None, "on": True, "off": False}[args.train_flash]
+    tc = TrainingConfig(
+        batch_size=args.batch,
+        block_size=args.seq_len,
+        grad_acc_steps=1,
+        dtype=args.dtype if args.dtype != "float16" else "bfloat16",
+        use_flash=use_flash,
+    )
+    trainer = Trainer(cfg, tc)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(
+        1, cfg.vocab_size, (args.train_steps + 1, 1, args.batch, args.seq_len + 1)
+    )
+    xs, ys = toks[..., :-1].astype(np.int32), toks[..., 1:].astype(np.int32)
+
+    # train_step returns float(loss), which blocks on the jitted step's
+    # outputs — so each iteration below is device-synchronized and the
+    # wall clock measures completed steps, not async dispatch
+    loss = trainer.train_step(xs[0], ys[0])  # compile + warmup
+    profiler_cm = None
+    if args.profile:
+        profiler_cm = jax.profiler.trace(args.profile)
+        profiler_cm.__enter__()
+    t0 = time.perf_counter()
+    for i in range(1, args.train_steps + 1):
+        loss = trainer.train_step(xs[i], ys[i])
+    wall = time.perf_counter() - t0
+    if profiler_cm is not None:
+        profiler_cm.__exit__(None, None, None)
+
+    toks_per_step = args.batch * args.seq_len
+    tps = args.train_steps * toks_per_step / wall
+    flops_tok = estimate_flops_per_token(cfg, args.seq_len)
+    V5E_BF16_PEAK = 197e12
+    mfu = tps * flops_tok / V5E_BF16_PEAK
+    return {
+        "metric": f"train tokens/sec/chip ({args.model}, B={args.batch}, "
+                  f"T={args.seq_len}, flash={trainer.use_flash})",
+        "value": round(tps, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 6),
+        "detail": {
+            "mfu_vs_v5e_bf16_peak": round(mfu, 6),
+            "tflops_per_s": round(tps * flops_tok / 1e12, 2),
+            "steps": args.train_steps,
+            "step_s": round(wall / args.train_steps, 4),
+            "final_loss": round(float(loss), 4),
+            "use_flash": bool(trainer.use_flash),
+            "config": {
+                "model": args.model, "batch": args.batch,
+                "seq_len": args.seq_len, "dtype": tc.dtype,
+            },
+            "device": str(jax.devices()[0]),
+        },
     }
 
 
@@ -398,6 +486,10 @@ def run_direct(args):
         return run_probe()
     if args.mode == "prefill":
         return run_prefill(args)
+    if args.mode == "train":
+        if args.pipeline:
+            raise SystemExit("--mode train benches the unmeshed Trainer; drop --pipeline")
+        return run_train(args)
     return run_decode(args)
 
 

@@ -167,3 +167,28 @@ def test_everything_fails_still_emits(monkeypatch):
 def test_baseline_for_routes_by_model():
     assert bench.baseline_for("Llama-3-8B-Instruct") == bench.JETSON_8B_TOKENS_PER_S
     assert bench.baseline_for("tiny-llama-1.1b") == bench.REFERENCE_TOKENS_PER_S
+
+
+def test_ring_row_is_last_so_its_wedge_skips_nothing():
+    # the ring row has the costliest compile in the suite (its r5 cold
+    # compile blew a 900 s timeout and wedged the tunnel); it must stay
+    # last so a timeout there cannot skip any other row
+    assert bench.SUITE_ROWS[-1]["name"] == "ring-pipeline-m16"
+
+
+def test_train_mode_smoke():
+    # a few real optimizer steps on a registry model, loss finite, MFU in
+    # (0, 1).  run_train is called directly (bypassing run_direct's
+    # --backend handling): conftest.py already pins the CPU platform for
+    # every test process, so no backend flag is needed here
+    ap = bench.build_parser()
+    args = ap.parse_args(
+        ["--direct", "--mode", "train",
+         "--model", "pythia-14m", "--batch", "2", "--seq-len", "64",
+         "--train-steps", "2"]
+    )
+    out = bench.run_train(args)
+    assert out["unit"] == "tokens/s/chip"
+    assert out["value"] > 0
+    assert 0 < out["vs_baseline"] < 1
+    assert out["detail"]["final_loss"] == out["detail"]["final_loss"]  # not NaN
