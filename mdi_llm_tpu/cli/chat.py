@@ -154,6 +154,11 @@ def main(argv=None):
         )
 
     print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")
+    # Generator backends get cross-turn KV reuse: each turn prefills only
+    # its new tokens (ChatSession), so turn latency tracks the turn length
+    # rather than the conversation length.  Pipeline/sp engines re-prefill
+    # the window every turn (the reference's behavior for every backend).
+    session = eng.chat_session() if isinstance(eng, Generator) else None
     history: list[int] = []
     while True:
         try:
@@ -164,13 +169,30 @@ def main(argv=None):
         if not user:
             break
         turn = tokenizer.encode(prompt_style.apply(user)).tolist()
-        context = history + turn
-        limit = eng.max_seq_length - args.n_tokens - 1
-        if len(context) > limit > 0:
-            context = context[-limit:]  # slide the window
+        pre_turn = session.history[:] if session is not None else None
 
         printer = StreamPrinter(tokenizer, stop_seqs)
         try:
+            if session is not None:
+                # stream is already stop-filtered: raw emit; the session
+                # slides its own window and owns the history
+                for tok in session.send(
+                    turn,
+                    args.n_tokens,
+                    temperature=args.temperature,
+                    top_k=args.top_k,
+                    top_p=args.top_p,
+                    stop_sequences=stop_seqs,
+                ):
+                    printer.emit(tok)
+                print()
+                continue
+
+            context = history + turn
+            limit = eng.max_seq_length - args.n_tokens - 1
+            if len(context) > limit > 0:
+                context = context[-limit:]  # slide the window
+
             if args.pipeline_stages:
                 # stream via the ring's collect callback; the engine's
                 # returned (trimmed) list is authoritative — finish()
@@ -198,6 +220,15 @@ def main(argv=None):
                     printer.emit(tok)
         except KeyboardInterrupt:
             print("\n[interrupted]")
+            if session is not None:
+                # mid-stream interrupt skipped the generator's reconcile
+                # step, so cache and history are desynced; keep the
+                # conversation (pre-turn history + this turn + the partial
+                # reply, matching the stateless path) and let the next send
+                # rebuild the cache with one full prefill
+                session.rollback(pre_turn + turn + printer.reply)
+                print()
+                continue
         print()
         history = context + printer.reply
     return 0

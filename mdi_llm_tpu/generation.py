@@ -788,20 +788,243 @@ class Generator:
         self.key, sub = jax.random.split(self.key)
         tok = sample(last_logits, sub, temperature=temperature, top_k=top_k, top_p=top_p)
         tok = np.asarray(tok.astype(jnp.int32))
-        decode = self._decode_fn(1)
-        pos = np.asarray([lens], np.int32)
-        history: List[int] = []
-        for i in range(max_new_tokens):
-            t = int(tok[0])
-            history.append(t)
-            yield t
-            if detect_stop_tokens(history, stop_sequences):
-                return
-            if i == max_new_tokens - 1 or int(pos[0]) + 1 >= cache_len:
-                return
-            tok_j, kv, self.key = decode(
-                self.params, jnp.asarray(tok)[:, None], kv, jnp.asarray(pos), self.key,
-                temperature=temperature, top_k=top_k, top_p=top_p,
+        yield from _decode_token_stream(
+            self, [kv], tok, lens, cache_len, max_new_tokens,
+            temperature, top_k, top_p, stop_sequences,
+        )
+
+    def _prefill_at_fn(self, T: int):
+        """Chunk prefill at a running cache offset (used by `ChatSession`):
+        forward T tokens whose absolute start is `pos`, write their KV into
+        the session cache, return the logits at the last real token.  Unlike
+        `_prefill_fn` this attends THROUGH the cache buffer
+        (fresh_prefill=False) so earlier turns' entries participate; masking
+        is strictly by absolute position — the same contract the speculative
+        `_verify_fn` relies on — so slots at or beyond the query position
+        are invisible regardless of their contents."""
+        key_ = ("chat_prefill", T)
+        if key_ not in self._decode_chunk_fns:
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def prefill_at(params, tokens, kv, pos, true_len):
+                logits, kv = transformer.forward(
+                    self.cfg, params, tokens, pos, kv=kv, rope=self.rope,
+                    moe_impl=self._moe_impl,
+                )
+                last = jnp.take_along_axis(
+                    logits, (true_len - 1)[:, None, None], axis=1
+                )[:, 0]
+                return last, kv
+
+            self._decode_chunk_fns[key_] = prefill_at
+        return self._decode_chunk_fns[key_]
+
+    def chat_session(self) -> "ChatSession":
+        """A stateful conversation handle with cross-turn KV reuse."""
+        return ChatSession(self)
+
+
+
+
+def _decode_token_stream(
+    gen: Generator,
+    kvbox: List[Any],
+    first_tok: np.ndarray,
+    start_pos: int,
+    cache_len: int,
+    max_new: int,
+    temperature, top_k, top_p, stop_sequences,
+    fed: Optional[List[int]] = None,
+):
+    """Shared single-sample decode loop: yield raw sampled tokens one at a
+    time (stop filtering is the caller's job).  `kvbox[0]` holds the live KV
+    cache through the donation cycle so callers that persist the cache
+    (ChatSession) see the latest buffer even if the stream is abandoned;
+    `fed`, when given, counts tokens actually forwarded through the model
+    (all but the final sampled one)."""
+    decode = gen._decode_fn(1)
+    tok = first_tok
+    pos = np.asarray([start_pos], np.int32)
+    emitted: List[int] = []
+    for i in range(max_new):
+        t = int(tok[0])
+        emitted.append(t)
+        yield t
+        if detect_stop_tokens(emitted, stop_sequences):
+            return
+        if i == max_new - 1 or int(pos[0]) + 1 >= cache_len:
+            return
+        kv_in, kvbox[0] = kvbox[0], None  # donated
+        tok_j, kv_out, gen.key = decode(
+            gen.params, jnp.asarray(tok)[:, None], kv_in, jnp.asarray(pos),
+            gen.key, temperature=temperature, top_k=top_k, top_p=top_p,
+        )
+        kvbox[0] = kv_out
+        tok = np.asarray(tok_j)
+        if fed is not None:
+            fed[0] += 1
+        pos = pos + 1
+
+
+class ChatSession:
+    """Cross-turn KV reuse for interactive chat — a TPU-first upgrade over
+    the reference REPL (chat.py:36-54,174-200), which re-runs prefill over
+    the ENTIRE conversation every turn.  The session keeps one KV cache and
+    a running position; each `send` prefills only the new tokens at that
+    offset, so turn latency scales with the turn length, not the
+    conversation length.
+
+    Works on any Generator backend (single-device, tp, ep, quantized).
+    Compile shapes stay bounded: turn prefills use power-of-two buckets
+    only (the session slides the window early rather than compile an
+    arbitrary residual width), and the cache grows geometrically from the
+    first turn's run-sized length toward `max_seq_length` (decode HBM
+    traffic tracks the conversation, and growth recompiles are O(log)).
+
+    State invariant between sends: `history` is the logical conversation;
+    the cache holds real entries for all of it except the trailing
+    `_pending` tokens (at most the final sampled reply token, which was
+    never fed through the model).  Rolled-back slots (stop-marker tokens
+    trimmed from a reply) are dead by the absolute-position masking
+    contract and are overwritten by the next turn's prefill.
+    """
+
+    def __init__(self, gen: Generator):
+        if gen._dp > 1:
+            raise ValueError("chat session streams one sample; use a tp-only mesh")
+        self.gen = gen
+        self.reset()
+
+    def reset(self) -> None:
+        self.history: List[int] = []
+        self._kvbox: List[Any] = [None]
+        self._cache_len = 0
+        self._pos = 0  # cache slots holding real (attendable) entries
+        self._pending: List[int] = []  # history tail not yet in the cache
+
+    def rollback(self, history: Sequence[int]) -> None:
+        """Restore a logical conversation (e.g. after interrupting a reply
+        mid-stream): the cache is rebuilt by one full prefill on the next
+        send, the same cost the stateless REPL pays every turn."""
+        self.reset()
+        self.history = list(history)
+        self._pending = list(history)
+
+    @property
+    def capacity(self) -> int:
+        return self.gen.max_seq_length
+
+    @property
+    def used(self) -> int:
+        return len(self.history)
+
+    def send(
+        self,
+        turn: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> Iterator[int]:
+        """Stream the reply to `turn` (stop-filtered, like generate_chat).
+        Session state updates as the iterator is consumed; exhaust it before
+        the next send."""
+        turn = list(turn)
+        max_new = int(max_new_tokens)
+        if not turn:
+            raise ValueError("empty turn")
+        if max_new + 1 >= self.gen.max_seq_length:
+            raise ValueError("max_new_tokens too large for max_seq_length")
+        return self._send(turn, max_new, temperature, top_k, top_p, stop_sequences)
+
+    def _grow_cache(self, needed: int) -> None:
+        """Ensure the cache covers `needed` slots: grow geometrically (at
+        least doubling, 256-slot granularity) and copy existing entries into
+        the leading corner — dynamic_update_slice at the origin is layout-
+        agnostic in which axis is the sequence."""
+        gen = self.gen
+        if self._cache_len >= needed:
+            return
+        new_len = min(
+            gen.max_seq_length,
+            max(_cache_bucket(needed), 2 * self._cache_len),
+        )
+        fresh = gen._place_kv(
+            transformer.init_kv_cache(gen.cfg, 1, new_len, dtype=gen.cache_dtype)
+        )
+        old = self._kvbox[0]
+        if old is not None and self._pos > 0:
+            fresh = jax.tree_util.tree_map(
+                lambda big, small: jax.lax.dynamic_update_slice(
+                    big, small.astype(big.dtype), (0,) * big.ndim
+                ),
+                fresh, old,
             )
-            tok = np.asarray(tok_j)
-            pos = pos + 1
+        self._kvbox[0] = fresh
+        self._cache_len = new_len
+
+    def _send(self, turn, max_new, temperature, top_k, top_p, stop_sequences):
+        gen = self.gen
+        cap = gen.max_seq_length
+        self.history.extend(turn)
+        feed = self._pending + turn
+        lens = len(feed)
+        # Slide the window when the conversation outgrows capacity
+        # (reference behavior) — and also, at a nonzero offset, when the
+        # pow2 prefill bucket no longer fits: compiling an arbitrary
+        # residual width would add a one-off jit shape per boundary turn,
+        # so pay one full re-prefill instead and keep the shape set small.
+        fits_exact = self._pos + lens + max_new + 1 <= cap
+        fits_bucket = self._pos + _bucket(lens) + max_new + 1 <= cap
+        if not fits_exact or (self._pos > 0 and not fits_bucket):
+            window = self.history[-(cap - max_new - 1):]
+            self._kvbox, self._cache_len = [None], 0
+            self._pos, self._pending = 0, []
+            self.history = list(window)
+            feed = window
+            lens = len(feed)
+        fresh_start = self._pos == 0
+        Tb = min(_bucket(lens), cap) if fresh_start else _bucket(lens)
+        self._grow_cache(min(cap, self._pos + max(Tb, lens + max_new)))
+        cache_len = self._cache_len
+        batch = np.zeros((1, Tb), np.int32)
+        batch[0, :lens] = np.asarray(feed, np.int32)
+        kv, self._kvbox[0] = self._kvbox[0], None  # donated to prefill
+        if fresh_start:
+            # empty cache at offset 0: the fresh-prefill path applies (and
+            # engages the Pallas flash kernel on long pasted prompts)
+            last, kv = gen._prefill_fn(1, Tb)(
+                gen.params, jnp.asarray(batch), kv, jnp.asarray([lens], jnp.int32)
+            )
+        else:
+            last, kv = gen._prefill_at_fn(Tb)(
+                gen.params, jnp.asarray(batch), kv,
+                jnp.asarray([self._pos], jnp.int32),
+                jnp.asarray([lens], jnp.int32),
+            )
+        self._kvbox[0] = kv
+        prompt_end = self._pos + lens
+        self._pos = prompt_end
+        self._pending = []
+
+        gen.key, sub = jax.random.split(gen.key)
+        tok = sample(last, sub, temperature=temperature, top_k=top_k, top_p=top_p)
+        tok = np.asarray(tok.astype(jnp.int32))
+        fed = [0]
+        raw = _decode_token_stream(
+            gen, self._kvbox, tok, prompt_end, cache_len, max_new,
+            temperature, top_k, top_p, stop_sequences, fed=fed,
+        )
+        reply: List[int] = []
+        for t in stop_filtered_stream(raw, stop_sequences):
+            reply.append(t)
+            yield t
+        # reconcile: the cache holds prompt + the fed reply prefix; the
+        # logical reply may be shorter (stop marker trimmed -> roll back
+        # those slots) or one longer (the final sampled token was never
+        # fed -> carry it as pending for the next turn's prefill)
+        self.history.extend(reply)
+        keep = min(len(reply), fed[0])
+        self._pos = prompt_end + keep
+        self._pending = reply[keep:]

@@ -203,3 +203,117 @@ def test_ngram_draft_lookup():
     assert ngram_draft([1, 2], 4) == []
     # latest earlier occurrence of [7,7] starts at index 2; only one token follows
     assert ngram_draft([7, 7, 7, 7, 7], 2, ngram=2) == [7]
+
+
+# ---------------------------------------------------------------------------
+# ChatSession: cross-turn KV reuse must be token-identical to the stateless
+# full-history re-prefill the reference REPL performs every turn
+# ---------------------------------------------------------------------------
+
+
+def _baseline_turn(cfg, params, history, turn, n, stop=()):
+    """Reference behavior: re-prefill the whole conversation every turn."""
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    return list(gen.generate_chat(history + turn, n, temperature=0.0,
+                                  stop_sequences=stop))
+
+
+def test_chat_session_matches_full_reprefill(small_model):
+    cfg, params = small_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    sess = gen.chat_session()
+    history: list[int] = []
+    for turn in ([5, 6, 7], [11, 2], [23, 23, 4, 9]):
+        want = _baseline_turn(cfg, params, history, turn, 8)
+        got = list(sess.send(turn, 8, temperature=0.0))
+        assert got == want, f"turn {turn}: session diverged from re-prefill"
+        history += turn + want
+        assert sess.history == history
+
+
+def test_chat_session_stop_sequence_and_pending(small_model):
+    """A turn trimmed by a stop marker must roll the cache back to the
+    logical reply (dead slots invisible), and a turn that ends at max_new
+    leaves its final token pending — both must keep later turns identical
+    to the stateless baseline."""
+    cfg, params = small_model
+    # discover greedy continuation to build a real stop marker
+    free = _baseline_turn(cfg, params, [], [9, 9], 10)
+    stop = [[free[2]]]
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    sess = gen.chat_session()
+    history: list[int] = []
+    for turn, st in (([9, 9], stop), ([3, 1, 4], ()), ([1, 5], stop)):
+        want = _baseline_turn(cfg, params, history, turn, 10, st)
+        got = list(sess.send(turn, 10, temperature=0.0, stop_sequences=st))
+        assert got == want
+        history += turn + want
+        assert sess.history == history
+
+
+def test_chat_session_window_slide(small_model):
+    """When the conversation outgrows max_seq_length the session must slide
+    the window and keep matching a stateless run over the same window."""
+    cfg, params = small_model
+    gen = Generator(cfg, params, max_seq_length=48, cache_dtype=jnp.float32)
+    sess = gen.chat_session()
+    history: list[int] = []
+    for i in range(5):  # 5 turns x (4 prompt + 6 reply) overflows 48
+        turn = [2 + i, 3 + i, 5 + i, 7 + i]
+        window = (history + turn)[-(48 - 6 - 1):]
+        want = _baseline_turn(cfg, params, window[: len(window) - len(turn)],
+                              window[len(window) - len(turn):], 6)
+        got = list(sess.send(turn, 6, temperature=0.0))
+        assert got == want, f"turn {i} diverged"
+        history = sess.history[:]
+    assert len(sess.history) <= 48
+
+
+def test_chat_session_empty_turn_raises(small_model):
+    cfg, params = small_model
+    sess = Generator(cfg, params, cache_dtype=jnp.float32).chat_session()
+    with pytest.raises(ValueError, match="empty turn"):
+        list(sess.send([], 4, temperature=0.0))
+
+
+def test_chat_session_cache_growth_preserves_parity():
+    """The session cache starts run-sized and grows geometrically; growth
+    copies existing entries (layout-agnostic corner update), so replies
+    across a growth boundary must still match the stateless baseline."""
+    cfg = tiny_config(block_size=1024)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    sess = gen.chat_session()
+    history: list[int] = []
+    sizes = []
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        turn = rng.integers(1, cfg.vocab_size, 60).tolist()
+        want = _baseline_turn(cfg, params, history, turn, 40)
+        got = list(sess.send(turn, 40, temperature=0.0))
+        assert got == want
+        history += turn + want
+        sizes.append(sess._cache_len)
+    assert sizes[0] < 1024, "cache should start run-sized, not max-sized"
+    assert sizes[-1] > sizes[0], "cache never grew across 300 tokens"
+
+
+def test_chat_session_rollback_after_partial_reply():
+    """Abandoning a reply mid-stream then rolling back must reproduce the
+    stateless baseline over (pre-turn history + turn + partial reply) —
+    the chat CLI's Ctrl-C contract."""
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    sess = gen.chat_session()
+    first = list(sess.send([5, 6, 7], 6, temperature=0.0))
+    pre = sess.history[:]
+    turn = [11, 2, 9]
+    it = sess.send(turn, 8, temperature=0.0)
+    partial = [next(it), next(it)]  # "Ctrl-C" after 2 tokens
+    sess.rollback(pre + turn + partial)
+    next_turn = [4, 4]
+    want = _baseline_turn(cfg, params, pre + turn + partial, next_turn, 6)
+    got = list(sess.send(next_turn, 6, temperature=0.0))
+    assert got == want
+    assert sess.history == pre + turn + partial + next_turn + got
