@@ -423,6 +423,25 @@ class Generator:
             self._prefill_fns[(B, T)] = prefill
         return self._prefill_fns[(B, T)]
 
+    def _broadcast_lanes_fn(self, B: int):
+        """Replicate a 1-lane prefill result across B decode lanes (shared-
+        prompt fast path): logits along axis 0, KV along the cache's batch
+        axis 1 — (L, B, G, S, hs), transformer.init_kv_cache."""
+        key_ = ("bcast", B)
+        if key_ not in self._decode_chunk_fns:
+
+            # no donation: the B-lane output cannot reuse the 1-lane buffer
+            @jax.jit
+            def bcast(last1, kv1):
+                last = jnp.repeat(last1, B, axis=0)
+                kv = jax.tree_util.tree_map(
+                    lambda x: jnp.repeat(x, B, axis=1), kv1
+                )
+                return last, kv
+
+            self._decode_chunk_fns[key_] = bcast
+        return self._decode_chunk_fns[key_]
+
     def _decode_fn(self, B: int):
         if B not in self._decode_fns:
 
@@ -509,6 +528,7 @@ class Generator:
         chunk_size: int = 16,
         speculative: Optional[int] = None,
         compact: bool = True,
+        shared_prefill: bool = True,
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for a batch of token-id prompts.
 
@@ -534,6 +554,14 @@ class Generator:
         context and verified in one forward pass, emitting up to K+1 tokens
         per dispatch.  Exact (token-identical to plain greedy); requires
         temperature == 0 and a single sample.
+
+        `shared_prefill` (unmeshed runs only): when every prompt is
+        identical (the reference's n-samples workload), prefill runs once
+        at B=1 and the cache/logits broadcast across lanes.  Greedy
+        streams are unchanged; with temperature > 0 the B=1 prefill may
+        differ from the B-lane one in the last ULP (XLA accumulation
+        order), shifting exact RNG draws — pass shared_prefill=False for
+        draw-level parity with distinct-prompt batching.
         """
         if speculative:
             if temperature != 0.0 or len(prompts) != 1:
@@ -568,15 +596,35 @@ class Generator:
         # cache sized to this run, not the engine maximum (jit retraces per
         # cache shape; the 256-granularity keeps the shape set small)
         cache_len = _run_cache_len(self.max_seq_length, total_max, Tb)
-        kv = self._place_kv(
-            transformer.init_kv_cache(self.cfg, B, cache_len, dtype=self.cache_dtype)
-        )
 
         stats = GenerationStats()
         t0 = time.perf_counter()
-        last_logits, kv = self._prefill_fn(B, Tb)(
-            self.params, jnp.asarray(batch), kv, jnp.asarray(lens, jnp.int32)
+        # N identical prompts (the reference's headline workload: n-samples
+        # of one prompt, starter.py --n-samples) need only ONE lane of
+        # prefill compute: run it at B=1 and broadcast the cache/logits
+        # across lanes on device.  Unmeshed only — under dp/tp the lanes
+        # and cache are sharded and the plain prefill is already parallel.
+        p0 = list(prompts[0])
+        shared = (
+            shared_prefill and B > 1 and self.mesh is None
+            and all(list(p) == p0 for p in prompts[1:])
         )
+        if shared:
+            kv1 = transformer.init_kv_cache(
+                self.cfg, 1, cache_len, dtype=self.cache_dtype
+            )
+            last1, kv1 = self._prefill_fn(1, Tb)(
+                self.params, jnp.asarray(batch[:1]), kv1,
+                jnp.asarray(lens[:1], jnp.int32),
+            )
+            last_logits, kv = self._broadcast_lanes_fn(B)(last1, kv1)
+        else:
+            kv = self._place_kv(
+                transformer.init_kv_cache(self.cfg, B, cache_len, dtype=self.cache_dtype)
+            )
+            last_logits, kv = self._prefill_fn(B, Tb)(
+                self.params, jnp.asarray(batch), kv, jnp.asarray(lens, jnp.int32)
+            )
         # first sampled token (from prefill logits)
         self.key, sub = jax.random.split(self.key)
         tok = sample(last_logits, sub, temperature=temperature, top_k=top_k, top_p=top_p)

@@ -317,3 +317,31 @@ def test_chat_session_rollback_after_partial_reply():
     got = list(sess.send(next_turn, 6, temperature=0.0))
     assert got == want
     assert sess.history == pre + turn + partial + next_turn + got
+
+
+def test_shared_prompt_prefill_matches_per_lane(small_model):
+    """Identical prompts take the broadcast fast path (one lane of prefill
+    compute); outputs must be token-identical to distinct-prompt batching
+    semantics — i.e. to what each lane produces alone under greedy."""
+    cfg, params = small_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    single, _ = gen.generate([[7, 3, 9]], 10, temperature=0.0)
+    shared, _ = gen.generate([[7, 3, 9]] * 4, 10, temperature=0.0)
+    assert shared == [single[0]] * 4
+    # stop sequences still apply per lane on the broadcast path
+    third = single[0][3 + 2]
+    stopped, _ = gen.generate(
+        [[7, 3, 9]] * 3, 10, temperature=0.0, stop_sequences=[[third]]
+    )
+    assert stopped == [single[0][: 3 + 2]] * 3
+
+
+def test_shared_prompt_numpy_prompts_and_opt_out(small_model):
+    """np.ndarray prompts must batch fine (duck-typed Sequence[int]) and
+    shared_prefill=False must force the per-lane prefill path."""
+    cfg, params = small_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    arr = np.asarray([7, 3, 9], np.int32)
+    fast, _ = gen.generate([arr, arr], 6, temperature=0.0)
+    slow, _ = gen.generate([arr, arr], 6, temperature=0.0, shared_prefill=False)
+    assert fast == slow
