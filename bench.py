@@ -57,9 +57,10 @@ def build_parser():
     ap.add_argument("--probe", action="store_true",
                     help="with --direct: only bring up the backend and run a tiny matmul")
     # sized for a fully COLD compile cache: tunnel compiles dominate (the
-    # r5 8B int8 row returned at t=1150 s, int4 is comparable, ring >900 s);
-    # with a warm .jax_cache/ the whole suite fits in a few hundred seconds
-    ap.add_argument("--suite-budget", type=float, default=5400.0,
+    # r5 8B int8 row returned at t=1150 s, int4 is comparable, ring and the
+    # T=2048 train step >900 s each); with a warm .jax_cache/ the whole
+    # suite fits in a few hundred seconds
+    ap.add_argument("--suite-budget", type=float, default=7200.0,
                     help="suite mode: stop launching new rows after this many seconds")
     ap.add_argument("--rows", default=None,
                     help="suite mode: comma-separated row names to run (default all)")
@@ -530,6 +531,18 @@ SUITE_ROWS = [
                    "--new-tokens", "512"],
         "ladder": [["--batch", "16"]],
         "timeout": 900,
+    },
+    {  # flash-VJP training on hardware: --train-flash on forces the Pallas
+        # custom_vjp (fails loudly if it cannot engage, e.g. a backend whose
+        # default_backend() string defeats the Trainer's auto gate); the
+        # ladder rung falls back to the auto gate so a kernel-path failure
+        # still records a training-MFU row (detail.use_flash says which ran;
+        # vs_baseline = fraction of the v5e bf16 peak).
+        "name": "tinyllama-train-2k",
+        "flags": ["--mode", "train", "--batch", "4", "--seq-len", "2048",
+                   "--train-steps", "4", "--train-flash", "on"],
+        "ladder": [["--train-flash", "auto"], ["--batch", "2"]],
+        "timeout": 1500,
     },
     {  # recurrent ring on one chip (the reference's headline execution
         # model).  LAST because it is the costliest compile in the suite:

@@ -169,11 +169,13 @@ def test_baseline_for_routes_by_model():
     assert bench.baseline_for("tiny-llama-1.1b") == bench.REFERENCE_TOKENS_PER_S
 
 
-def test_ring_row_is_last_so_its_wedge_skips_nothing():
+def test_costly_compiles_run_after_every_decode_row():
     # the ring row has the costliest compile in the suite (its r5 cold
-    # compile blew a 900 s timeout and wedged the tunnel); it must stay
-    # last so a timeout there cannot skip any other row
-    assert bench.SUITE_ROWS[-1]["name"] == "ring-pipeline-m16"
+    # compile blew a 900 s timeout and wedged the tunnel); it and the
+    # train row must come after every decode row so a timeout cannot skip
+    # a north-star measurement
+    names = [r["name"] for r in bench.SUITE_ROWS]
+    assert names[-2:] == ["tinyllama-train-2k", "ring-pipeline-m16"]
 
 
 def test_train_mode_smoke():
