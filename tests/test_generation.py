@@ -345,3 +345,18 @@ def test_shared_prompt_numpy_prompts_and_opt_out(small_model):
     fast, _ = gen.generate([arr, arr], 6, temperature=0.0)
     slow, _ = gen.generate([arr, arr], 6, temperature=0.0, shared_prefill=False)
     assert fast == slow
+
+
+def test_chat_session_quantized_matches_quantized_reprefill(small_model):
+    """ChatSession on an int8-quantized generator must equal the quantized
+    stateless baseline (same tree, full re-prefill per turn)."""
+    cfg, params = small_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32, quantize="int8")
+    sess = gen.chat_session()
+    base = Generator(cfg, params, cache_dtype=jnp.float32, quantize="int8")
+    history: list[int] = []
+    for turn in ([5, 6, 7], [11, 2]):
+        want = list(base.generate_chat(history + turn, 8, temperature=0.0))
+        got = list(sess.send(turn, 8, temperature=0.0))
+        assert got == want
+        history += turn + want
