@@ -178,3 +178,21 @@ def test_tp_moe_experts_sharded(devices):
     )
     got, _ = eng.generate(PROMPTS[:2], 8, temperature=0.0)
     assert got == want
+
+
+def test_chat_session_on_tp_mesh(model, single, devices):
+    """ChatSession cross-turn KV reuse over a tp=2 mesh: token-identical to
+    the single-device stateless baseline across turns (the sharded cache
+    persists and grows across sends)."""
+    cfg, params = model
+    eng = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"tp": 2}, devices[:2]),
+    )
+    sess = eng.chat_session()
+    history: list[int] = []
+    for turn in ([3, 1, 4], [9, 2]):
+        want = list(single.generate_chat(history + turn, 8, temperature=0.0))
+        got = list(sess.send(turn, 8, temperature=0.0))
+        assert got == want
+        history += turn + want
