@@ -38,6 +38,12 @@ def build_parser():
     ap.add_argument("--top-k", type=int, default=TOP_K)
     ap.add_argument("--top-p", type=float, default=None)
     ap.add_argument(
+        "--speculative", type=int, default=0, metavar="K",
+        help="greedy speculative chat: draft K tokens by n-gram lookup over "
+        "the whole conversation, verify in one forward (requires "
+        "--temperature 0; Generator backends only)",
+    )
+    ap.add_argument(
         "--tp-devices",
         type=int,
         default=0,
@@ -109,6 +115,14 @@ def main(argv=None):
             "are separate streaming backends; pick one (for a pipe x tp "
             "mesh use cli/starter.py)"
         )
+    if args.speculative:
+        if args.temperature != 0.0:
+            raise SystemExit("--speculative requires --temperature 0 (greedy)")
+        if args.pipeline_stages or args.sp_devices:
+            raise SystemExit(
+                "--speculative applies to Generator backends "
+                "(single-device/tp/ep); drop --pipeline-stages/--sp-devices"
+            )
     cfg, params, tokenizer, prompt_style = load_model(args)
     if tokenizer is None:
         raise SystemExit("chat needs a checkpoint with a tokenizer (--ckpt)")
@@ -183,6 +197,7 @@ def main(argv=None):
                     top_k=args.top_k,
                     top_p=args.top_p,
                     stop_sequences=stop_seqs,
+                    speculative=args.speculative or None,
                 ):
                     printer.emit(tok)
                 print()

@@ -696,16 +696,9 @@ class Generator:
                         tok = toks_np[fed - 1]
                         positions = positions + fed
                         continue
-                    draft = (list(draft) + [0] * K)[:K]
-                    toks_in = np.asarray([[int(tok[0])] + draft], np.int32)
-                    g, kv = self._verify_fn(K + 1)(
-                        self.params, jnp.asarray(toks_in), kv, jnp.asarray(positions)
+                    emitted, kv = _verify_accept(
+                        self, kv, tok, draft, K, positions
                     )
-                    g = np.asarray(g)[0]  # greedy successor at each position
-                    a = 0
-                    while a < K and draft[a] == int(g[a]):
-                        a += 1
-                    emitted = [int(x) for x in g[: a + 1]]
                     allowed = min(len(emitted), max_new_tokens - n)
                     fed = 0
                     for t in emitted[:allowed]:
@@ -874,6 +867,23 @@ class Generator:
 
 
 
+def _verify_accept(gen: Generator, kv, tok, draft, K: int, positions):
+    """Speculative verify-and-accept core, shared by `generate()`'s fast
+    path and `ChatSession`: pad the draft to K, score [tok]+draft in one
+    forward (`_verify_fn`), and return (burst, kv) where burst is the
+    accepted prefix plus the bonus token (greedy successors)."""
+    draft = (list(draft) + [0] * K)[:K]
+    toks_in = np.asarray([[int(tok[0])] + draft], np.int32)
+    g, kv = gen._verify_fn(K + 1)(
+        gen.params, jnp.asarray(toks_in), kv, jnp.asarray(positions)
+    )
+    g = np.asarray(g)[0]
+    a = 0
+    while a < K and draft[a] == int(g[a]):
+        a += 1
+    return [int(x) for x in g[: a + 1]], kv
+
+
 def _decode_token_stream(
     gen: Generator,
     kvbox: List[Any],
@@ -974,17 +984,29 @@ class ChatSession:
         top_k: Optional[int] = TOP_K,
         top_p: Optional[float] = None,
         stop_sequences: Sequence[Sequence[int]] = (),
+        speculative: Optional[int] = None,
     ) -> Iterator[int]:
         """Stream the reply to `turn` (stop-filtered, like generate_chat).
         Session state updates as the iterator is consumed; exhaust it before
-        the next send."""
+        the next send.
+
+        `speculative=K` (greedy only): draft K tokens by prompt-lookup over
+        the WHOLE conversation — chat replies echo earlier turns, which is
+        exactly the regime where n-gram drafting hits — and verify them in
+        one forward pass, emitting up to K+1 tokens per dispatch.  Exact
+        (token-identical to the plain stream)."""
         turn = list(turn)
         max_new = int(max_new_tokens)
         if not turn:
             raise ValueError("empty turn")
         if max_new + 1 >= self.gen.max_seq_length:
             raise ValueError("max_new_tokens too large for max_seq_length")
-        return self._send(turn, max_new, temperature, top_k, top_p, stop_sequences)
+        if speculative and temperature != 0.0:
+            raise ValueError("speculative chat requires temperature=0")
+        return self._send(
+            turn, max_new, temperature, top_k, top_p, stop_sequences,
+            speculative=int(speculative) if speculative else None,
+        )
 
     def _grow_cache(self, needed: int) -> None:
         """Ensure the cache covers `needed` slots: grow geometrically (at
@@ -1012,7 +1034,73 @@ class ChatSession:
         self._kvbox[0] = fresh
         self._cache_len = new_len
 
-    def _send(self, turn, max_new, temperature, top_k, top_p, stop_sequences):
+    def _spec_raw_stream(
+        self, tok0, prompt_end, cache_len, max_new, K, top_k, top_p,
+        stop_sequences, posbox,
+    ):
+        """Greedy speculative raw stream for a session turn: draft K tokens
+        by n-gram lookup over conversation+reply, verify in one forward
+        (`_verify_fn`), emit the matching prefix + bonus token.  Falls back
+        to single plain decode steps when no draft is found or the cache is
+        nearly full.  `posbox[0]` tracks the absolute position of the
+        current (unfed) token so the caller can reconcile cache state."""
+        gen = self.gen
+        tok = tok0
+        pos = prompt_end  # absolute slot of the current unfed token
+        emitted: List[int] = [int(tok[0])]
+        posbox[0] = pos
+        yield emitted[0]
+        miss_skip = 0  # after a lookup miss, decode a few plain steps
+        # before rescanning: the O(history) host-side n-gram scan per token
+        # would otherwise rival the device step cost on non-echoing replies
+        while len(emitted) < max_new:
+            if detect_stop_tokens(emitted, stop_sequences):
+                return
+            room = cache_len - pos - 1
+            if room < 1:
+                return
+            draft = []
+            if room >= K + 1 and miss_skip == 0:
+                draft = ngram_draft(self.history + emitted, K)
+                if not draft:
+                    miss_skip = 4
+            if draft:
+                kv_in, self._kvbox[0] = self._kvbox[0], None  # donated
+                burst, kv_out = _verify_accept(
+                    gen, kv_in, tok, draft, K, [pos]
+                )
+                self._kvbox[0] = kv_out
+                fed = 0
+                stopped = False
+                for t in burst[: max_new - len(emitted)]:
+                    emitted.append(t)
+                    fed += 1
+                    yield t
+                    if detect_stop_tokens(emitted, stop_sequences):
+                        stopped = True
+                        break
+                tok = np.asarray([emitted[-1]], np.int32)
+                pos += fed
+                posbox[0] = pos
+                if stopped:
+                    return
+            else:
+                miss_skip = max(0, miss_skip - 1)
+                kv_in, self._kvbox[0] = self._kvbox[0], None  # donated
+                tok_j, kv_out, gen.key = gen._decode_fn(1)(
+                    gen.params, jnp.asarray(tok)[:, None], kv_in,
+                    jnp.asarray([pos], jnp.int32), gen.key,
+                    temperature=0.0, top_k=top_k, top_p=top_p,
+                )
+                self._kvbox[0] = kv_out
+                tok = np.asarray(tok_j)
+                pos += 1
+                posbox[0] = pos
+                emitted.append(int(tok[0]))
+                yield emitted[-1]
+
+    def _send(self, turn, max_new, temperature, top_k, top_p, stop_sequences,
+              speculative=None):
         gen = self.gen
         cap = gen.max_seq_length
         self.history.extend(turn)
@@ -1059,20 +1147,29 @@ class ChatSession:
         gen.key, sub = jax.random.split(gen.key)
         tok = sample(last, sub, temperature=temperature, top_k=top_k, top_p=top_p)
         tok = np.asarray(tok.astype(jnp.int32))
-        fed = [0]
-        raw = _decode_token_stream(
-            gen, self._kvbox, tok, prompt_end, cache_len, max_new,
-            temperature, top_k, top_p, stop_sequences, fed=fed,
-        )
+        if speculative:
+            posbox = [prompt_end]
+            raw = self._spec_raw_stream(
+                tok, prompt_end, cache_len, max_new, speculative,
+                top_k, top_p, stop_sequences, posbox,
+            )
+        else:
+            fed = [0]
+            raw = _decode_token_stream(
+                gen, self._kvbox, tok, prompt_end, cache_len, max_new,
+                temperature, top_k, top_p, stop_sequences, fed=fed,
+            )
         reply: List[int] = []
         for t in stop_filtered_stream(raw, stop_sequences):
             reply.append(t)
             yield t
         # reconcile: the cache holds prompt + the fed reply prefix; the
         # logical reply may be shorter (stop marker trimmed -> roll back
-        # those slots) or one longer (the final sampled token was never
-        # fed -> carry it as pending for the next turn's prefill)
+        # those slots) or longer than what was fed (the final sampled token
+        # — or a speculative bonus burst — was never fed -> carry as
+        # pending for the next turn's prefill)
         self.history.extend(reply)
-        keep = min(len(reply), fed[0])
+        advance = (posbox[0] - prompt_end) if speculative else fed[0]
+        keep = min(len(reply), advance)
         self._pos = prompt_end + keep
         self._pending = reply[keep:]

@@ -360,3 +360,37 @@ def test_chat_session_quantized_matches_quantized_reprefill(small_model):
         got = list(sess.send(turn, 8, temperature=0.0))
         assert got == want
         history += turn + want
+
+
+def test_chat_session_speculative_matches_plain(small_model):
+    """Speculative chat turns must be token-identical to plain session
+    turns (greedy), across turns so drafting draws on earlier turns, with
+    reply lengths capped at max_new."""
+    cfg, params = small_model
+    plain = Generator(cfg, params, cache_dtype=jnp.float32).chat_session()
+    spec = Generator(cfg, params, cache_dtype=jnp.float32).chat_session()
+    for turn in ([5, 6, 7, 5, 6], [5, 6, 7, 5], [9, 1, 5, 6]):
+        want = list(plain.send(turn, 9, temperature=0.0))
+        got = list(spec.send(turn, 9, temperature=0.0, speculative=3))
+        assert got == want
+        assert len(got) <= 9
+        assert spec.history == plain.history
+
+
+def test_chat_session_speculative_stop_and_guards(small_model):
+    cfg, params = small_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    free = list(gen.chat_session().send([9, 9], 10, temperature=0.0))
+    stop = [[free[2]]]
+    plain = Generator(cfg, params, cache_dtype=jnp.float32).chat_session()
+    spec = Generator(cfg, params, cache_dtype=jnp.float32).chat_session()
+    want = list(plain.send([9, 9], 10, temperature=0.0, stop_sequences=stop))
+    got = list(spec.send([9, 9], 10, temperature=0.0, stop_sequences=stop,
+                         speculative=4))
+    assert got == want
+    # follow-up turn still consistent after a speculative stop-trim
+    want2 = list(plain.send([4, 2], 6, temperature=0.0))
+    got2 = list(spec.send([4, 2], 6, temperature=0.0, speculative=4))
+    assert got2 == want2
+    with pytest.raises(ValueError, match="temperature=0"):
+        spec.send([1], 4, temperature=0.8, speculative=3)
