@@ -168,11 +168,12 @@ def main(argv=None):
         )
 
     print(f"Chatting with {cfg.name} — empty line or Ctrl-D to exit.")
-    # Generator backends get cross-turn KV reuse: each turn prefills only
-    # its new tokens (ChatSession), so turn latency tracks the turn length
-    # rather than the conversation length.  Pipeline/sp engines re-prefill
-    # the window every turn (the reference's behavior for every backend).
-    session = eng.chat_session() if isinstance(eng, Generator) else None
+    # Generator and sp backends get cross-turn KV reuse: each turn
+    # prefills (or, on sp, round-robin-appends) only its new tokens, so
+    # turn latency tracks the turn length rather than the conversation
+    # length.  The pipeline engine re-prefills the window every turn
+    # (the reference's behavior for every backend).
+    session = eng.chat_session() if hasattr(eng, "chat_session") else None
     history: list[int] = []
     while True:
         try:

@@ -26,7 +26,7 @@ tests/test_sp_inference.py.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,7 @@ from mdi_llm_tpu.generation import (
     _bucket,
     detect_stop_tokens,
     find_eot,
+    stop_filtered_stream,
 )
 from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.ops.sampling import sample
@@ -373,8 +374,6 @@ class SPGenerator:
         same way it drives every other backend.  Tokens surface per decode
         chunk (`decode_chunk`; pass a small one for lower time-to-first-
         byte at a modest dispatch-rate cost)."""
-        from mdi_llm_tpu.generation import stop_filtered_stream
-
         return stop_filtered_stream(
             self._generate_stream(
                 prompt, max_new_tokens, temperature, top_k, top_p, stop_sequences
@@ -428,3 +427,267 @@ class SPGenerator:
                 yield t
                 if detect_stop_tokens(history, stop_sequences):
                     return
+
+    def _get_append(self, Tl, C, Tp, B=1):
+        """Teacher-forced cache append for `SPChatSession`: feed Tp given
+        tokens (the first `true_len` real) through the decode path one at a
+        time, writing each real token's K/V at its round-robin slot
+        (owner = step % P at local row Tl + step // P — the same math as
+        `_get_decode`), and return the logits at the last real token.
+        Padded steps (i >= true_len) run the forward but mask both the
+        cache write and the kp stamp, so the pow2 bucket Tp adds no
+        attendable garbage and the compile-shape set stays bounded."""
+        key = ("append", B, Tl, C, Tp)
+        if key not in self._decode_jit:
+            cfg, Pn = self.cfg, self.P
+
+            def body(params, rope, kv, kp, toks_in, true_len, pos, step0):
+                d = jax.lax.axis_index("sp")
+
+                def step(carry, i):
+                    kv, kp, pos, last = carry
+                    tok = jax.lax.dynamic_slice_in_dim(toks_in, i, 1, axis=1)
+                    real = i < true_len
+                    owner = (step0 + i) % Pn
+                    loc = Tl + (step0 + i) // Pn
+                    write_on = jnp.logical_and(owner == d, real)
+                    kp = jnp.where(
+                        write_on,
+                        jax.lax.dynamic_update_slice(kp, pos[:, None], (0, loc)),
+                        kp,
+                    )
+                    logits, kv = transformer.forward(
+                        cfg, params, tok, pos, kv=kv, rope=rope,
+                        sp_axis="sp", sp_meta=(kp, loc, write_on),
+                    )
+                    last = jnp.where(
+                        i == true_len - 1, logits[:, -1].astype(jnp.float32), last
+                    )
+                    pos = pos + real.astype(jnp.int32)
+                    return (kv, kp, pos, last), None
+
+                last0 = jnp.zeros((B, cfg.padded_vocab_size), jnp.float32)
+                (kv, kp, pos, last), _ = jax.lax.scan(
+                    step, (kv, kp, pos, last0), jnp.arange(Tp, dtype=jnp.int32)
+                )
+                # every device computed the same replicated logits; psum/P
+                # is unnecessary — the forward under shard_map already
+                # reduces attention over the ring, so `last` is identical
+                # on all devices
+                return kv, kp, pos, last
+
+            repl = P()
+            sm = jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    jax.tree_util.tree_map(lambda _: repl, self.params),
+                    (repl, repl),
+                    self._kv_spec,
+                    P(None, "sp"),
+                    repl,
+                    repl,
+                    repl,
+                    repl,
+                ),
+                out_specs=(self._kv_spec, P(None, "sp"), repl, repl),
+            )
+            self._decode_jit[key] = jax.jit(sm, donate_argnums=(2, 3))
+        return self._decode_jit[key]
+
+    def chat_session(self) -> "SPChatSession":
+        """A stateful long-context conversation handle with cross-turn
+        sequence-sharded KV reuse."""
+        return SPChatSession(self)
+
+
+class SPChatSession:
+    """Cross-turn KV reuse over the sp mesh — the long-context variant of
+    `generation.ChatSession`.  The first turn (and any window rebuild) runs
+    the ring-attention prefill; every later turn APPENDS its tokens to the
+    sequence-sharded cache through the round-robin decode path
+    (`_get_append`), so turn cost is O(turn length) decode-rate work
+    instead of O(conversation) ring prefill — on the 32k-context 8B
+    serving shape this is the difference between a sub-second and a
+    multi-second turn start.
+
+    State invariant between sends (single sample, B=1): `history` is the
+    logical conversation; the cache holds slots for all of it except the
+    trailing `_pending` tokens.  Stop-trimmed reply tokens that were
+    already fed are rolled back by CLEARING their kp stamps (sp attention
+    is kp-masked, so — unlike the single-chip session's absolute-position
+    masking — a stale stamped slot WOULD be attendable; the explicit clear
+    restores invisibility) and rewinding the step counter, after which the
+    next turn's appends rewrite those slots."""
+
+    def __init__(self, gen: SPGenerator):
+        self.gen = gen
+        self.reset()
+
+    def reset(self) -> None:
+        self.history: List[int] = []
+        self._kv = None
+        self._kp = None
+        self._Tl = 0
+        self._C = 0
+        self._pos = 0    # logical tokens with live cache slots
+        self._steps = 0  # decode/append round-robin steps consumed
+        self._pending: List[int] = []
+
+    def rollback(self, history: Sequence[int]) -> None:
+        """Restore a logical conversation (Ctrl-C contract): the cache is
+        rebuilt by one ring prefill on the next send."""
+        self.reset()
+        self.history = list(history)
+        self._pending = list(history)
+
+    @property
+    def capacity(self) -> int:
+        return self.gen.max_seq_length
+
+    def send(
+        self,
+        turn: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+        speculative: Optional[int] = None,
+    ) -> Iterator[int]:
+        """Stream the stop-filtered reply to `turn`; session state updates
+        as the iterator is consumed (exhaust it before the next send)."""
+        turn = list(turn)
+        max_new = int(max_new_tokens)
+        if speculative:
+            raise ValueError(
+                "speculative chat is not implemented on the sp backend"
+            )
+        if not turn:
+            raise ValueError("empty turn")
+        if max_new + 1 >= self.gen.max_seq_length:
+            raise ValueError("max_new_tokens too large for max_seq_length")
+        return self._send(turn, max_new, temperature, top_k, top_p, stop_sequences)
+
+    def _clear_steps(self, kp, first_step: int, n: int):
+        """Host-side kp fixup: mark the slots of step indices
+        [first_step, first_step + n) empty again (stop-trim rollback)."""
+        gen = self.gen
+        kp_np = np.array(jax.device_get(kp))
+        for s in range(first_step, first_step + n):
+            owner = s % gen.P
+            loc = self._Tl + s // gen.P
+            kp_np[0, owner * self._C + loc] = POS_SENTINEL
+        sh = NamedSharding(gen.mesh, P(None, "sp"))
+        return jax.device_put(jnp.asarray(kp_np), sh)
+
+    def _send(self, turn, max_new, temperature, top_k, top_p, stop_sequences):
+        gen = self.gen
+        cap = gen.max_seq_length
+        Pn = gen.P
+        self.history.extend(turn)
+        feed = self._pending + turn
+        fresh = self._kv is None
+        if not fresh:
+            logical_ok = self._pos + len(feed) + max_new + 1 <= cap
+            slots_ok = (
+                self._steps + _bucket(len(feed)) + max_new
+                <= Pn * (self._C - self._Tl)
+            )
+            if not (logical_ok and slots_ok):
+                fresh = True
+        sampling = dict(temperature=temperature, top_k=top_k, top_p=top_p)
+        if fresh:
+            window = self.history[-(cap - max_new - 1):]
+            self.history = list(window)
+            feed = window
+            lens = len(feed)
+            # decode/append region sized for the session maximum (cap), so
+            # the (Tl, C) compile-shape set stays bounded across rebuilds
+            Tl = -(-min(_bucket(lens), cap) // Pn)
+            C = Tl + -(-cap // Pn)
+            toks_np = np.zeros((1, Tl * Pn), np.int32)
+            toks_np[0, :lens] = np.asarray(feed, np.int32)
+            kv = gen._init_kv(1, C)
+            gen.key, sub = jax.random.split(gen.key)
+            kv, kp, tok = gen._get_prefill(1, Tl, C, **sampling)(
+                gen.params, gen.rope, jnp.asarray(toks_np),
+                jnp.asarray([lens], jnp.int32), kv, sub,
+            )
+            self._kv, self._kp = kv, kp
+            self._Tl, self._C = Tl, C
+            self._pos, self._steps = lens, 0
+            first = int(np.asarray(tok)[0])  # tok stays the device array
+        else:
+            L = len(feed)
+            Tp = _bucket(L)
+            toks_np = np.zeros((1, Tp), np.int32)
+            toks_np[0, :L] = np.asarray(feed, np.int32)
+            kv, self._kv = self._kv, None  # donated
+            kp, self._kp = self._kp, None  # donated
+            # _pos/_steps advance host-side below; the returned pos
+            # duplicates that bookkeeping
+            kv, kp, _pos_out, last = gen._get_append(self._Tl, self._C, Tp)(
+                gen.params, gen.rope, kv, kp, jnp.asarray(toks_np),
+                jnp.int32(L), jnp.asarray([self._pos], jnp.int32),
+                jnp.int32(self._steps),
+            )
+            self._kv, self._kp = kv, kp
+            self._pos += L
+            self._steps += L
+            gen.key, sub = jax.random.split(gen.key)
+            tok = sample(last, sub, **sampling).astype(jnp.int32)
+            first = int(np.asarray(tok)[0])
+        self._pending = []
+        prompt_end = self._pos
+        step_base = self._steps
+
+        emitted: List[int] = [first]
+        fed_total = [0]
+
+        def raw_stream():
+            nonlocal tok
+            pos = jnp.asarray([prompt_end], jnp.int32)
+            yield first
+            if detect_stop_tokens(emitted, stop_sequences):
+                return
+            n = 1
+            step0 = step_base
+            while n < max_new:
+                c = min(gen.decode_chunk, max_new - n)
+                decode = gen._get_decode(1, self._Tl, self._C, c, **sampling)
+                gen.key, sub = jax.random.split(gen.key)
+                kv_in, self._kv = self._kv, None  # donated
+                kp_in, self._kp = self._kp, None  # donated
+                kv, kp, tok, pos, toks = decode(
+                    gen.params, gen.rope, kv_in, kp_in, tok, pos,
+                    jnp.int32(step0), sub,
+                )
+                self._kv, self._kp = kv, kp
+                step0 += c
+                fed_total[0] += c
+                chunk = np.asarray(toks)
+                for i in range(c):
+                    n += 1
+                    t = int(chunk[i, 0])
+                    emitted.append(t)
+                    yield t
+                    if detect_stop_tokens(emitted, stop_sequences):
+                        return
+
+        reply: List[int] = []
+        for t in stop_filtered_stream(raw_stream(), stop_sequences):
+            reply.append(t)
+            yield t
+        # reconcile (see class docstring): fed reply tokens beyond the
+        # trimmed reply get their kp stamps cleared so their slots go back
+        # to invisible; the final sampled-but-unfed token (or trimmed
+        # tail) carries over as pending
+        self.history.extend(reply)
+        keep = min(len(reply), fed_total[0])
+        excess = fed_total[0] - keep
+        if excess > 0:
+            self._kp = self._clear_steps(self._kp, step_base + keep, excess)
+        self._pos = prompt_end + keep
+        self._steps = step_base + keep
+        self._pending = reply[keep:]

@@ -219,3 +219,87 @@ def test_sp_generate_chat_streams_same_tokens(model, devices):
     want_stop, _ = sp.generate([prompt], 11, temperature=0.0, stop_sequences=stop)
     got_stop = list(sp.generate_chat(prompt, 11, temperature=0.0, stop_sequences=stop))
     assert got_stop == want_stop[0][len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# SPChatSession: cross-turn sequence-sharded KV reuse
+# ---------------------------------------------------------------------------
+
+
+def _single_baseline(cfg, params, history, turn, n, stop=()):
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    return list(gen.generate_chat(history + turn, n, temperature=0.0,
+                                  stop_sequences=stop))
+
+
+@pytest.mark.parametrize("n_devices", [2, 4])
+def test_sp_chat_session_matches_single_device(model, n_devices, devices):
+    """Turn appends through the round-robin decode path must keep every
+    turn token-identical to single-device full-history re-prefill."""
+    cfg, params = model
+    sp = SPGenerator(
+        cfg, params, devices=devices[:n_devices], cache_dtype=jnp.float32
+    )
+    sess = sp.chat_session()
+    history: list[int] = []
+    for turn in ([3, 1, 4, 1, 5], [9, 2], [6, 5, 3, 5]):
+        want = _single_baseline(cfg, params, history, turn, 8)
+        got = list(sess.send(turn, 8, temperature=0.0))
+        assert got == want, f"turn {turn} diverged"
+        history += turn + want
+        assert sess.history == history
+
+
+def test_sp_chat_session_stop_rollback_clears_kp(model, devices):
+    """A stop-trimmed reply must clear the rolled-back slots' kp stamps —
+    under kp-masked sp attention a stale stamp would be attendable — and
+    later turns must stay token-identical."""
+    cfg, params = model
+    free = _single_baseline(cfg, params, [], [9, 9, 1], 10)
+    stop = [[free[3]]]
+    sp = SPGenerator(cfg, params, devices=devices[:2], cache_dtype=jnp.float32)
+    sess = sp.chat_session()
+    history: list[int] = []
+    for turn, st in (([9, 9, 1], stop), ([4, 2, 8], ()), ([1, 3], stop)):
+        want = _single_baseline(cfg, params, history, turn, 10, st)
+        got = list(sess.send(turn, 10, temperature=0.0, stop_sequences=st))
+        assert got == want
+        history += turn + want
+        assert sess.history == history
+
+
+def test_sp_chat_session_window_rebuild(model, devices):
+    """Outgrowing max_seq_length must rebuild via ring prefill over the
+    slid window and keep matching a stateless run over that window."""
+    cfg, params = model
+    sp = SPGenerator(
+        cfg, params, devices=devices[:2], max_seq_length=64,
+        cache_dtype=jnp.float32,
+    )
+    sess = sp.chat_session()
+    for i in range(5):  # 5 x (4 + 8) tokens overflows 64
+        turn = [2 + i, 3 + i, 5 + i, 7 + i]
+        got = list(sess.send(turn, 8, temperature=0.0))
+        # authoritative check: session history must match a stateless
+        # single-device run over the exact window the session kept (the
+        # window always ends with the full turn: its size cap-max_new-1
+        # exceeds any turn here)
+        prompt = sess.history[: len(sess.history) - len(got)]
+        assert prompt[-len(turn):] == turn
+        want = _single_baseline(cfg, params, prompt[: -len(turn)], turn, 8)
+        assert got == want, f"turn {i} diverged"
+    assert len(sess.history) <= 64
+
+
+def test_sp_chat_session_rollback(model, devices):
+    cfg, params = model
+    sp = SPGenerator(cfg, params, devices=devices[:2], cache_dtype=jnp.float32)
+    sess = sp.chat_session()
+    _ = list(sess.send([5, 6, 7], 6, temperature=0.0))
+    pre = sess.history[:]
+    it = sess.send([11, 2], 8, temperature=0.0)
+    partial = [next(it), next(it)]
+    sess.rollback(pre + [11, 2] + partial)
+    want = _single_baseline(cfg, params, pre + [11, 2] + partial, [4, 4], 6)
+    got = list(sess.send([4, 4], 6, temperature=0.0))
+    assert got == want
