@@ -41,7 +41,7 @@ def build_parser():
         "--speculative", type=int, default=0, metavar="K",
         help="greedy speculative chat: draft K tokens by n-gram lookup over "
         "the whole conversation, verify in one forward (requires "
-        "--temperature 0; Generator backends only)",
+        "--temperature 0; single-device/tp/ep/sp backends)",
     )
     ap.add_argument(
         "--tp-devices",
@@ -118,10 +118,10 @@ def main(argv=None):
     if args.speculative:
         if args.temperature != 0.0:
             raise SystemExit("--speculative requires --temperature 0 (greedy)")
-        if args.pipeline_stages or args.sp_devices:
+        if args.pipeline_stages:
             raise SystemExit(
-                "--speculative applies to Generator backends "
-                "(single-device/tp/ep); drop --pipeline-stages/--sp-devices"
+                "--speculative applies to session backends "
+                "(single-device/tp/ep/sp); drop --pipeline-stages"
             )
     cfg, params, tokenizer, prompt_style = load_model(args)
     if tokenizer is None:

@@ -867,21 +867,32 @@ class Generator:
 
 
 
+def pad_draft(draft, K: int) -> List[int]:
+    """Pad/trim an n-gram draft to exactly K tokens (0-padding; padded
+    positions can only be rejected)."""
+    return (list(draft) + [0] * K)[:K]
+
+
+def accept_draft(draft, g, K: int) -> List[int]:
+    """Longest-accepted-prefix rule shared by every speculative backend:
+    `g[i]` is the greedy successor of ([tok]+draft)[i]; accept while the
+    draft agrees, return the accepted tokens plus the bonus successor."""
+    a = 0
+    while a < K and draft[a] == int(g[a]):
+        a += 1
+    return [int(x) for x in g[: a + 1]]
+
+
 def _verify_accept(gen: Generator, kv, tok, draft, K: int, positions):
     """Speculative verify-and-accept core, shared by `generate()`'s fast
     path and `ChatSession`: pad the draft to K, score [tok]+draft in one
-    forward (`_verify_fn`), and return (burst, kv) where burst is the
-    accepted prefix plus the bonus token (greedy successors)."""
-    draft = (list(draft) + [0] * K)[:K]
+    forward (`_verify_fn`), and return (burst, kv)."""
+    draft = pad_draft(draft, K)
     toks_in = np.asarray([[int(tok[0])] + draft], np.int32)
     g, kv = gen._verify_fn(K + 1)(
         gen.params, jnp.asarray(toks_in), kv, jnp.asarray(positions)
     )
-    g = np.asarray(g)[0]
-    a = 0
-    while a < K and draft[a] == int(g[a]):
-        a += 1
-    return [int(x) for x in g[: a + 1]], kv
+    return accept_draft(draft, np.asarray(g)[0], K), kv
 
 
 def _decode_token_stream(

@@ -40,6 +40,9 @@ from mdi_llm_tpu.generation import (
     _bucket,
     detect_stop_tokens,
     find_eot,
+    accept_draft,
+    ngram_draft,
+    pad_draft,
     stop_filtered_stream,
 )
 from mdi_llm_tpu.models import transformer
@@ -433,10 +436,13 @@ class SPGenerator:
         tokens (the first `true_len` real) through the decode path one at a
         time, writing each real token's K/V at its round-robin slot
         (owner = step % P at local row Tl + step // P — the same math as
-        `_get_decode`), and return the logits at the last real token.
-        Padded steps (i >= true_len) run the forward but mask both the
-        cache write and the kp stamp, so the pow2 bucket Tp adds no
-        attendable garbage and the compile-shape set stays bounded."""
+        `_get_decode`), and return the logits at the last real token PLUS
+        the greedy successor at every step — which makes the same kernel
+        the speculative verify pass (feed [tok]+draft, compare successors
+        against the draft, ≡ Generator._verify_fn).  Padded steps
+        (i >= true_len) run the forward but mask both the cache write and
+        the kp stamp, so the pow2 bucket Tp adds no attendable garbage
+        and the compile-shape set stays bounded."""
         key = ("append", B, Tl, C, Tp)
         if key not in self._decode_jit:
             cfg, Pn = self.cfg, self.P
@@ -463,18 +469,19 @@ class SPGenerator:
                     last = jnp.where(
                         i == true_len - 1, logits[:, -1].astype(jnp.float32), last
                     )
+                    g = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                     pos = pos + real.astype(jnp.int32)
-                    return (kv, kp, pos, last), None
+                    return (kv, kp, pos, last), g
 
                 last0 = jnp.zeros((B, cfg.padded_vocab_size), jnp.float32)
-                (kv, kp, pos, last), _ = jax.lax.scan(
+                (kv, kp, pos, last), greedy = jax.lax.scan(
                     step, (kv, kp, pos, last0), jnp.arange(Tp, dtype=jnp.int32)
                 )
                 # every device computed the same replicated logits; psum/P
                 # is unnecessary — the forward under shard_map already
-                # reduces attention over the ring, so `last` is identical
-                # on all devices
-                return kv, kp, pos, last
+                # reduces attention over the ring, so `last`/`greedy` are
+                # identical on all devices
+                return kv, kp, pos, last, greedy  # greedy: (Tp, B)
 
             repl = P()
             sm = jax.shard_map(
@@ -490,7 +497,7 @@ class SPGenerator:
                     repl,
                     repl,
                 ),
-                out_specs=(self._kv_spec, P(None, "sp"), repl, repl),
+                out_specs=(self._kv_spec, P(None, "sp"), repl, repl, repl),
             )
             self._decode_jit[key] = jax.jit(sm, donate_argnums=(2, 3))
         return self._decode_jit[key]
@@ -559,29 +566,54 @@ class SPChatSession:
         as the iterator is consumed (exhaust it before the next send)."""
         turn = list(turn)
         max_new = int(max_new_tokens)
-        if speculative:
-            raise ValueError(
-                "speculative chat is not implemented on the sp backend"
-            )
+        if speculative and temperature != 0.0:
+            raise ValueError("speculative chat requires temperature=0")
         if not turn:
             raise ValueError("empty turn")
         if max_new + 1 >= self.gen.max_seq_length:
             raise ValueError("max_new_tokens too large for max_seq_length")
-        return self._send(turn, max_new, temperature, top_k, top_p, stop_sequences)
+        return self._send(
+            turn, max_new, temperature, top_k, top_p, stop_sequences,
+            speculative=int(speculative) if speculative else None,
+        )
 
     def _clear_steps(self, kp, first_step: int, n: int):
-        """Host-side kp fixup: mark the slots of step indices
-        [first_step, first_step + n) empty again (stop-trim rollback)."""
+        """Mark the slots of step indices [first_step, first_step + n)
+        empty again (speculative draft rejection and stop-trim rollback).
+        Runs as a jitted device-side scatter — this sits on the speculative
+        hot path (once per burst with any rejected draft), so a host
+        round-trip of the kp array would eat the speedup.  Indices are
+        computed host-side and padded to a pow2 bucket (duplicates write
+        the same sentinel, so padding by repetition is harmless)."""
         gen = self.gen
-        kp_np = np.array(jax.device_get(kp))
-        for s in range(first_step, first_step + n):
-            owner = s % gen.P
-            loc = self._Tl + s // gen.P
-            kp_np[0, owner * self._C + loc] = POS_SENTINEL
-        sh = NamedSharding(gen.mesh, P(None, "sp"))
-        return jax.device_put(jnp.asarray(kp_np), sh)
+        cols = [
+            (s % gen.P) * self._C + self._Tl + s // gen.P
+            for s in range(first_step, first_step + n)
+        ]
+        nb = _bucket(len(cols), minimum=4)
+        cols = (cols + [cols[0]] * nb)[:nb]
+        key = ("clear", nb, self._C)
+        if key not in gen._decode_jit:
+            C, Pn = self._C, gen.P
 
-    def _send(self, turn, max_new, temperature, top_k, top_p, stop_sequences):
+            def body(kp_local, idx):
+                d = jax.lax.axis_index("sp")
+                local = idx - d * C
+                ok = jnp.logical_and(local >= 0, local < C)
+                li = jnp.clip(local, 0, C - 1)
+                vals = jnp.where(ok, POS_SENTINEL, kp_local[0, li])
+                return kp_local.at[0, li].set(vals)
+
+            sm = jax.shard_map(
+                body, mesh=gen.mesh,
+                in_specs=(P(None, "sp"), P()),
+                out_specs=P(None, "sp"),
+            )
+            gen._decode_jit[key] = jax.jit(sm, donate_argnums=(0,))
+        return gen._decode_jit[key](kp, jnp.asarray(cols, jnp.int32))
+
+    def _send(self, turn, max_new, temperature, top_k, top_p, stop_sequences,
+              speculative=None):
         gen = self.gen
         cap = gen.max_seq_length
         Pn = gen.P
@@ -627,7 +659,7 @@ class SPChatSession:
             kp, self._kp = self._kp, None  # donated
             # _pos/_steps advance host-side below; the returned pos
             # duplicates that bookkeeping
-            kv, kp, _pos_out, last = gen._get_append(self._Tl, self._C, Tp)(
+            kv, kp, _pos_out, last, _g = gen._get_append(self._Tl, self._C, Tp)(
                 gen.params, gen.rope, kv, kp, jnp.asarray(toks_np),
                 jnp.int32(L), jnp.asarray([self._pos], jnp.int32),
                 jnp.int32(self._steps),
@@ -644,6 +676,91 @@ class SPChatSession:
 
         emitted: List[int] = [first]
         fed_total = [0]
+
+        def spec_stream():
+            """Greedy speculative stream: the append kernel doubles as the
+            verify pass (feed [tok]+draft, compare its per-step greedy
+            successors against the draft).  Rejected draft tokens already
+            wrote slots + kp stamps — cleared immediately, and the step/pos
+            counters rewind to the accepted prefix, so the contiguous-slot
+            invariant the outer reconcile relies on is preserved."""
+            nonlocal tok
+            K = speculative
+            pos = prompt_end
+            yield first
+            miss_skip = 0
+            while len(emitted) < max_new:
+                if detect_stop_tokens(emitted, stop_sequences):
+                    return
+                # slots were budgeted upfront (len(feed) + max_new); drafting
+                # additionally needs the K+1-wide append to fit
+                slots_left = Pn * (self._C - self._Tl) - (
+                    step_base + fed_total[0]
+                ) - 1
+                draft = []
+                if miss_skip == 0 and slots_left >= K + 1:
+                    draft = ngram_draft(self.history + emitted, K)
+                    if not draft:
+                        miss_skip = 4
+                if draft:
+                    draft = pad_draft(draft, K)
+                    L = K + 1
+                    Tp = _bucket(L)
+                    toks_np = np.zeros((1, Tp), np.int32)
+                    toks_np[0, :L] = [int(tok[0])] + draft
+                    kv_in, self._kv = self._kv, None  # donated
+                    kp_in, self._kp = self._kp, None  # donated
+                    kv, kp, _p, _last, g = gen._get_append(
+                        self._Tl, self._C, Tp
+                    )(
+                        gen.params, gen.rope, kv_in, kp_in,
+                        jnp.asarray(toks_np), jnp.int32(L),
+                        jnp.asarray([pos], jnp.int32),
+                        jnp.int32(step_base + fed_total[0]),
+                    )
+                    self._kv, self._kp = kv, kp
+                    burst = accept_draft(draft, np.asarray(g)[:L, 0], K)
+                    a = len(burst) - 1
+                    # the append fed all L tokens; only tok + the accepted
+                    # a drafts are valid — clear the rejected tail's stamps
+                    # and rewind to keep slots contiguous
+                    accepted_fed = a + 1
+                    if L > accepted_fed:
+                        self._kp = self._clear_steps(
+                            self._kp,
+                            step_base + fed_total[0] + accepted_fed,
+                            L - accepted_fed,
+                        )
+                    fed_total[0] += accepted_fed
+                    pos += accepted_fed
+                    stopped = False
+                    for t in burst[: max_new - len(emitted)]:
+                        emitted.append(t)
+                        yield t
+                        if detect_stop_tokens(emitted, stop_sequences):
+                            stopped = True
+                            break
+                    tok = jnp.asarray([emitted[-1]], jnp.int32)
+                    if stopped:
+                        return
+                else:
+                    miss_skip = max(0, miss_skip - 1)
+                    decode = gen._get_decode(1, self._Tl, self._C, 1, **sampling)
+                    gen.key, sub = jax.random.split(gen.key)
+                    kv_in, self._kv = self._kv, None  # donated
+                    kp_in, self._kp = self._kp, None  # donated
+                    kv, kp, tok_j, _pj, toks = decode(
+                        gen.params, gen.rope, kv_in, kp_in,
+                        jnp.asarray(tok, jnp.int32),
+                        jnp.asarray([pos], jnp.int32),
+                        jnp.int32(step_base + fed_total[0]), sub,
+                    )
+                    self._kv, self._kp = kv, kp
+                    tok = tok_j
+                    fed_total[0] += 1
+                    pos += 1
+                    emitted.append(int(np.asarray(toks)[0, 0]))
+                    yield emitted[-1]
 
         def raw_stream():
             nonlocal tok
@@ -676,7 +793,8 @@ class SPChatSession:
                         return
 
         reply: List[int] = []
-        for t in stop_filtered_stream(raw_stream(), stop_sequences):
+        stream = spec_stream() if speculative else raw_stream()
+        for t in stop_filtered_stream(stream, stop_sequences):
             reply.append(t)
             yield t
         # reconcile (see class docstring): fed reply tokens beyond the

@@ -303,3 +303,46 @@ def test_sp_chat_session_rollback(model, devices):
     want = _single_baseline(cfg, params, pre + [11, 2] + partial, [4, 4], 6)
     got = list(sess.send([4, 4], 6, temperature=0.0))
     assert got == want
+
+
+@pytest.mark.parametrize("n_devices", [2, 4])
+def test_sp_chat_session_speculative_matches_plain(model, n_devices, devices):
+    """Speculative sp chat must be token-identical to the plain sp session
+    (greedy), across turns so drafting draws on earlier turns."""
+    cfg, params = model
+    plain = SPGenerator(
+        cfg, params, devices=devices[:n_devices], cache_dtype=jnp.float32
+    ).chat_session()
+    spec = SPGenerator(
+        cfg, params, devices=devices[:n_devices], cache_dtype=jnp.float32
+    ).chat_session()
+    for turn in ([5, 6, 7, 5, 6], [5, 6, 7, 5], [9, 1, 5, 6]):
+        want = list(plain.send(turn, 9, temperature=0.0))
+        got = list(spec.send(turn, 9, temperature=0.0, speculative=3))
+        assert got == want, f"turn {turn} diverged"
+        assert len(got) <= 9
+        assert spec.history == plain.history
+
+
+def test_sp_chat_session_speculative_stop_rollback(model, devices):
+    """A speculative burst trimmed by a stop marker must clear both the
+    rejected-draft slots and the stop-trimmed slots, keeping later turns
+    identical to the plain session."""
+    cfg, params = model
+    free = list(
+        SPGenerator(cfg, params, devices=devices[:2], cache_dtype=jnp.float32)
+        .chat_session().send([9, 9, 1], 10, temperature=0.0)
+    )
+    stop = [[free[3]]]
+    plain = SPGenerator(
+        cfg, params, devices=devices[:2], cache_dtype=jnp.float32
+    ).chat_session()
+    spec = SPGenerator(
+        cfg, params, devices=devices[:2], cache_dtype=jnp.float32
+    ).chat_session()
+    for turn, st in (([9, 9, 1], stop), ([4, 2, 8], ())):
+        want = list(plain.send(turn, 10, temperature=0.0, stop_sequences=st))
+        got = list(spec.send(turn, 10, temperature=0.0, stop_sequences=st,
+                             speculative=4))
+        assert got == want
+        assert spec.history == plain.history
