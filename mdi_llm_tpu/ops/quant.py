@@ -186,19 +186,23 @@ def init_quantized_params(cfg, seed: int = 0, mode: str = "w8", dtype=None):
     std = 0.02
     proj_std = std / (2 * L) ** 0.5  # ≡ init_params output-projection scaling
 
-    def qlin(out_d, in_d, s=std):
+    def qlin(out_d, in_d, s=std, lead=None):
+        """Quantized linear with leading stack dims `lead` (default (L,)):
+        matches quantize_tensor's last-axis scale convention for any rank,
+        so MoE expert stacks (L, E, out, in) mirror the real quantizer."""
+        lead = (L,) if lead is None else lead
         if mode == "w4":
             # random packed nibbles in [-8, 7]; rms 4.61 → matching scale
-            packed = rng.integers(-128, 128, (L, out_d, in_d // 2), dtype=np.int8)
+            packed = rng.integers(-128, 128, (*lead, out_d, in_d // 2), dtype=np.int8)
             g = w4_group_size(in_d)  # same halving rule as quantize_tensor4
             return {
                 wkey: packed,
-                "scale": np.full((L, out_d, in_d // g), s / 4.61, np.float32),
+                "scale": np.full((*lead, out_d, in_d // g), s / 4.61, np.float32),
             }
-        q = rng.integers(-127, 128, size=(L, out_d, in_d), dtype=np.int8)
+        q = rng.integers(-127, 128, size=(*lead, out_d, in_d), dtype=np.int8)
         # per-channel scale so the dequantized std matches init_params
         # (73.3 = rms of uniform int8 in [-127, 127])
-        return {wkey: q, "scale": np.full((L, out_d), s / 73.3, np.float32)}
+        return {wkey: q, "scale": np.full((*lead, out_d), s / 73.3, np.float32)}
 
     def norm():
         p = {"weight": np.ones((L, D), np_dtype)}
@@ -221,8 +225,21 @@ def init_quantized_params(cfg, seed: int = 0, mode: str = "w8", dtype=None):
             "fc_2": qlin(I, D),
             "proj": qlin(D, I, proj_std),
         }
+    elif cfg.mlp_class_name == "LLaMAMoE":
+        E = cfg.n_expert
+        mlp = {
+            "gate": qlin(E, D),  # (L, E, D): router logits einsum
+            "experts": {
+                "fc_1": qlin(I, D, lead=(L, E)),
+                "fc_2": qlin(I, D, lead=(L, E)),
+                "proj": qlin(D, I, proj_std, lead=(L, E)),
+            },
+        }
     else:
-        raise NotImplementedError("init_quantized_params: MoE not needed for bench")
+        raise NotImplementedError(
+            f"init_quantized_params: unknown mlp_class_name "
+            f"{cfg.mlp_class_name!r}"
+        )
     blocks = {"norm_1": norm(), "attn": attn, "mlp": mlp}
     if not cfg.shared_attention_norm:
         blocks["norm_2"] = norm()
