@@ -705,7 +705,53 @@ def run_suite(args):
         "suite_wall_s": round(elapsed(), 1),
         "events": events,
     }
+    banked = collect_banked_artifacts()
+    if banked:
+        out["detail"]["banked_artifacts"] = banked
     return out
+
+
+def collect_banked_artifacts():
+    """Summarize suite JSONs committed under bench_results/ (measurements
+    banked by earlier healthy-backend runs).  Attached to every suite
+    output so a run that lands on a wedged backend — the way round 4 lost
+    its TPU rows — still points at the hardware record."""
+    bdir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_results")
+    if not os.path.isdir(bdir):
+        return None
+    banked = {}
+    for f in sorted(os.listdir(bdir)):
+        if not f.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(bdir, f)) as fh:
+                data = json.load(fh)
+            detail = data.get("detail") if isinstance(data, dict) else None
+            rows_b = detail.get("rows") if isinstance(detail, dict) else None
+            keep = {
+                k: {
+                    "value": v.get("value"),
+                    "unit": v.get("unit"),
+                    "device": (v.get("detail") or {}).get("device")
+                    if isinstance(v.get("detail"), (dict, type(None)))
+                    else None,
+                }
+                for k, v in (rows_b or {}).items()
+                if isinstance(v, dict) and "value" in v
+            }
+        except Exception:
+            # this helper runs at the very end of run_suite: a malformed
+            # banked file must never cost the run its own measurements
+            continue
+        if keep:
+            banked[f] = keep
+    if not banked:
+        return None
+    return {
+        "note": "earlier healthy-backend measurements committed in "
+                "bench_results/ (see its README.md for provenance)",
+        "runs": banked,
+    }
 
 
 def main():
