@@ -449,3 +449,24 @@ def test_sp_moe_training_aux_matches_single_device(devices):
             a, b, rtol=2e-4, atol=2e-5,
             err_msg=f"param divergence at {jax.tree_util.keystr(k1)}",
         )
+
+
+def test_chat_session_on_ep_mesh(devices):
+    """ChatSession cross-turn KV reuse over an ep mesh: the token-dispatch
+    MoE path must stay token-identical to single-device full-history
+    re-prefill across turns (the offset prefill and decode both route
+    through ep_moe_forward)."""
+    from mdi_llm_tpu.generation import Generator
+
+    cfg = moe_config()
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    single = Generator(cfg, params, max_seq_length=64)
+    eng = Generator(cfg, params, max_seq_length=64, mesh=make_mesh({"ep": 4}, devices[:4]))
+    assert eng._moe_impl is not None
+    sess = eng.chat_session()
+    history: list[int] = []
+    for turn in ([3, 7, 11], [2, 5]):
+        want = list(single.generate_chat(history + turn, 8, temperature=0.0))
+        got = list(sess.send(turn, 8, temperature=0.0))
+        assert got == want
+        history += turn + want
