@@ -35,6 +35,7 @@ baseline, i.e. >=60 tokens/s.
 """
 
 import argparse
+import contextlib
 import json
 import os
 import subprocess
@@ -100,13 +101,21 @@ def build_parser():
         "steady-state ring rotations per jit call, default 16)",
     )
     ap.add_argument(
-        "--mode", choices=("decode", "prefill", "train"), default="decode",
+        "--mode", choices=("decode", "prefill", "train", "serve"), default="decode",
         help="prefill: compare flash-attention prefill latency vs the XLA "
         "path at --prompt-len and verify greedy-token agreement; "
         "train: time optimizer steps on synthetic data (tokens/s + MFU) — "
         "on TPU with --seq-len >= 2048 this exercises the Pallas flash "
-        "custom_vjp forward+backward on hardware",
+        "custom_vjp forward+backward on hardware; "
+        "serve: continuous-batching throughput over the paged KV pool on a "
+        "mixed-length synthetic request trace (tokens/s + KV-block "
+        "utilization; --batch = decode slots, --new-tokens = per-request "
+        "output ceiling)",
     )
+    ap.add_argument("--serve-requests", type=int, default=None,
+                    help="serve mode: queued requests (default 4x --batch)")
+    ap.add_argument("--serve-block-size", type=int, default=16,
+                    help="serve mode: KV pool block width (tokens)")
     ap.add_argument("--train-steps", type=int, default=6,
                     help="train mode: timed optimizer steps (after 1 warmup)")
     ap.add_argument(
@@ -185,16 +194,15 @@ def run_train(args):
     # outputs — so each iteration below is device-synchronized and the
     # wall clock measures completed steps, not async dispatch
     loss = trainer.train_step(xs[0], ys[0])  # compile + warmup
-    profiler_cm = None
-    if args.profile:
-        profiler_cm = jax.profiler.trace(args.profile)
-        profiler_cm.__enter__()
-    t0 = time.perf_counter()
-    for i in range(1, args.train_steps + 1):
-        loss = trainer.train_step(xs[i], ys[i])
-    wall = time.perf_counter() - t0
-    if profiler_cm is not None:
-        profiler_cm.__exit__(None, None, None)
+    # ExitStack so an exception inside the timed loop cannot leak an open
+    # profiler trace (a dangling trace wedges later jax.profiler sessions)
+    with contextlib.ExitStack() as stack:
+        if args.profile:
+            stack.enter_context(jax.profiler.trace(args.profile))
+        t0 = time.perf_counter()
+        for i in range(1, args.train_steps + 1):
+            loss = trainer.train_step(xs[i], ys[i])
+        wall = time.perf_counter() - t0
 
     toks_per_step = args.batch * args.seq_len
     tps = args.train_steps * toks_per_step / wall
@@ -346,6 +354,102 @@ def run_prefill(args):
     }
 
 
+def run_serve(args):
+    """Continuous-batching serving throughput over the paged KV pool.
+
+    Queues a mixed-length synthetic request trace (log-spread prompt
+    lengths, spread output budgets — the workload static batching handles
+    worst) into `Generator.serve()`'s engine and measures end-to-end
+    tokens/s plus KV-block utilization.  Compare against the static-batch
+    flagship row (`tinyllama-bf16`): the static row pads every lane to the
+    longest sample and holds dead lanes to the end, while this row admits,
+    retires and reuses blocks mid-batch — KV bytes/step scale with LIVE
+    tokens (docs/perf.md "Serving").
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mdi_llm_tpu.config import Config
+    from mdi_llm_tpu.models import transformer
+    from mdi_llm_tpu.cli._common import resolve_kv_dtype
+    from mdi_llm_tpu.cli.serve import synthetic_trace
+    from mdi_llm_tpu.generation import Generator
+
+    dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+             "float32": jnp.float32}[args.dtype]
+    kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
+    cfg = Config.from_name(args.model)
+    if args.pipeline:
+        raise SystemExit("--mode serve runs the single-chip engine; drop --pipeline")
+    if args.quantize != "none":
+        from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, init_quantized_params
+
+        params = jax.device_put(init_quantized_params(
+            cfg, mode=FLAG_TO_MODE[args.quantize], dtype=dtype
+        ))
+    else:
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    gen = Generator(cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype)
+    n_requests = args.serve_requests or 4 * args.batch
+
+    def build_engine():
+        return gen.serve(
+            block_size=args.serve_block_size,
+            max_batch=args.batch,
+            prefill_chunk=min(128, args.seq_len // 2),
+        )
+
+    trace = synthetic_trace(
+        n_requests, cfg.vocab_size, args.seq_len, args.new_tokens
+    )
+    # warmup on a trace PREFIX covering the compile shapes (prefill buckets
+    # + the fixed decode batch), then the timed run on a fresh engine
+    warm = build_engine()
+    for rid, prompt, new in trace[: min(len(trace), args.batch)]:
+        warm.add_request(rid, prompt, min(new, 8))
+    warm.run()
+
+    engine = build_engine()
+    for rid, prompt, new in trace:
+        engine.add_request(rid, prompt, new)
+    with contextlib.ExitStack() as stack:
+        if args.profile:
+            stack.enter_context(jax.profiler.trace(args.profile))
+        t0 = time.perf_counter()
+        results, stats = engine.run()
+        wall = time.perf_counter() - t0
+
+    value = stats.tokens_generated / wall if wall else 0.0
+    base = baseline_for(args.model)
+    return {
+        "metric": f"serving tokens/sec/chip ({args.model}, cb, "
+                  f"slots={args.batch}, reqs={n_requests})",
+        "value": round(value, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(value / base, 2),
+        "detail": {
+            "tokens_generated": stats.tokens_generated,
+            "requests": stats.requests_finished,
+            "wall_s": round(wall, 2),
+            "decode_steps": stats.decode_steps,
+            "prefill_chunks": stats.prefill_chunks,
+            "kv_block_utilization_mean": round(stats.kv_utilization_mean, 4),
+            "kv_block_utilization_peak": round(stats.kv_utilization_peak, 4),
+            "prefix_cache_hits": stats.prefix_cache_hits,
+            "preemptions": stats.preemptions,
+            "baseline_tokens_per_s": base,
+            "config": {
+                "model": args.model, "slots": args.batch,
+                "block_size": args.serve_block_size,
+                "seq_len": args.seq_len, "new_tokens": args.new_tokens,
+                "requests": n_requests, "kv_dtype": args.kv_dtype,
+                "quantize": args.quantize,
+            },
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
 def run_decode(args):
     """Batched (or pipeline-ring) decode throughput measurement."""
     import jax
@@ -414,15 +518,15 @@ def run_decode(args):
     # (prompt+max_new bucket), so a shorter warmup would compile a different
     # cache shape and the timed run would recompile inside the measurement
     engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
-    profiler_cm = None
-    if args.profile:
-        profiler_cm = jax.profiler.trace(args.profile)
-        profiler_cm.__enter__()
-    t0 = time.perf_counter()
-    outs, stats = engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
-    wall = time.perf_counter() - t0
-    if profiler_cm is not None:
-        profiler_cm.__exit__(None, None, None)
+    # ExitStack: see run_train — no leaked profiler trace on a failed run
+    with contextlib.ExitStack() as stack:
+        if args.profile:
+            stack.enter_context(jax.profiler.trace(args.profile))
+        t0 = time.perf_counter()
+        outs, stats = engine.generate(
+            prompts, args.new_tokens, temperature=0.0, **kwargs
+        )
+        wall = time.perf_counter() - t0
 
     toks = sum(len(o) - args.prompt_len for o in outs)
     decode_tps = stats.tokens_generated / stats.decode_s if stats.decode_s else 0.0
@@ -495,6 +599,8 @@ def run_direct(args):
         if args.pipeline:
             raise SystemExit("--mode train benches the unmeshed Trainer; drop --pipeline")
         return run_train(args)
+    if args.mode == "serve":
+        return run_serve(args)
     return run_decode(args)
 
 
@@ -534,6 +640,17 @@ SUITE_ROWS = [
         "flags": ["--quantize", "w8a8", "--batch", "24", "--chunk", "256",
                    "--new-tokens", "512"],
         "ladder": [["--batch", "16"]],
+        "timeout": 900,
+    },
+    {  # continuous-batching serving over the paged KV pool vs the static
+        # flagship row above: mixed-length trace, mid-batch admit/retire,
+        # tokens/s + KV-block utilization in detail.  Decode dispatches are
+        # per-step (no scan chunk), so the graph is small; the prefill
+        # buckets reuse shapes the flagship row already warmed in .jax_cache
+        "name": "serving-cb",
+        "flags": ["--mode", "serve", "--batch", "8", "--seq-len", "512",
+                   "--new-tokens", "128"],
+        "ladder": [["--batch", "4", "--new-tokens", "64"]],
         "timeout": 900,
     },
     {  # flash-VJP training on hardware: --train-flash on forces the Pallas

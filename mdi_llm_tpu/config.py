@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "Config",
+    "ServingConfig",
     "name_to_config",
     "configs",
     "find_multiple",
@@ -391,6 +392,32 @@ class Config:
         d = self.asdict()
         d.update(kw)
         return Config(**d)
+
+
+@dataclass
+class ServingConfig:
+    """Knobs for the paged-KV continuous-batching engine
+    (`serving.engine.ServingEngine`, built via `Generator.serve`).
+
+    Not to be confused with `Config.block_size` (the model's context
+    window): `block_size` HERE is the width of one KV pool block in tokens.
+    """
+
+    # KV pool geometry -------------------------------------------------------
+    block_size: int = 16  # tokens per KV block (pool page width)
+    max_blocks: Optional[int] = None  # pool size; None → full coverage
+    # (1 trash block + max_batch × ceil(max_seq_length / block_size))
+    # scheduling --------------------------------------------------------------
+    max_batch: int = 8  # concurrent decode slots (jit batch shape)
+    prefill_chunk: int = 128  # max prompt tokens per prefill dispatch
+    prefix_caching: bool = True  # hash-chain block reuse for shared prompts
+    # sampling (engine-wide: the decode step is one jitted batch) ------------
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    # attention backend: None → auto (Pallas kernel on TPU decode steps,
+    # exact lax gather fallback elsewhere — tier-1 CPU tests use the latter)
+    use_kernel: Optional[bool] = None
 
 
 def _yaml_scalar(v: Any) -> str:

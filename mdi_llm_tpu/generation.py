@@ -528,7 +528,7 @@ class Generator:
         chunk_size: int = 16,
         speculative: Optional[int] = None,
         compact: bool = True,
-        shared_prefill: bool = True,
+        shared_prefill: Optional[bool] = None,
     ) -> Tuple[List[List[int]], GenerationStats]:
         """Generate continuations for a batch of token-id prompts.
 
@@ -560,8 +560,13 @@ class Generator:
         at B=1 and the cache/logits broadcast across lanes.  Greedy
         streams are unchanged; with temperature > 0 the B=1 prefill may
         differ from the B-lane one in the last ULP (XLA accumulation
-        order), shifting exact RNG draws — pass shared_prefill=False for
-        draw-level parity with distinct-prompt batching.
+        order), shifting exact RNG draws.  The rule: `None` (default)
+        auto-enables the fast path only for greedy decoding
+        (temperature == 0), so identical-prompt SAMPLING workloads keep
+        draw-level reproducibility with distinct-prompt batching out of
+        the box; pass `True` to opt the broadcast path in regardless
+        (cheaper, distribution unchanged), `False` to force per-lane
+        prefill always.
         """
         if speculative:
             if temperature != 0.0 or len(prompts) != 1:
@@ -605,6 +610,8 @@ class Generator:
         # across lanes on device.  Unmeshed only — under dp/tp the lanes
         # and cache are sharded and the plain prefill is already parallel.
         p0 = list(prompts[0])
+        if shared_prefill is None:  # auto: greedy only (see docstring rule)
+            shared_prefill = temperature == 0.0
         shared = (
             shared_prefill and B > 1 and self.mesh is None
             and all(list(p) == p0 for p in prompts[1:])
@@ -834,6 +841,32 @@ class Generator:
             temperature, top_k, top_p, stop_sequences,
         )
 
+    def _grow_kv_fn(self, new_len: int):
+        """Jitted cache growth for `ChatSession`: allocate the longer cache
+        INSIDE jit and donate the old buffer, so XLA fuses zeros+copy into
+        one materialization and releases the old KV HBM immediately —
+        without donation both caches were live across the copy, a transient
+        ~2x KV spike at every growth boundary (ADVICE.md round 5)."""
+        key_ = ("grow", new_len)
+        if key_ not in self._decode_chunk_fns:
+
+            def grow(old):
+                fresh = transformer.init_kv_cache(
+                    self.cfg, 1, new_len, dtype=self.cache_dtype
+                )
+                return jax.tree_util.tree_map(
+                    lambda big, small: jax.lax.dynamic_update_slice(
+                        big, small.astype(big.dtype), (0,) * big.ndim
+                    ),
+                    fresh, old,
+                )
+
+            jit_kw: Dict[str, Any] = dict(donate_argnums=(0,))
+            if self._kv_sharding is not None:
+                jit_kw["out_shardings"] = self._kv_sharding
+            self._decode_chunk_fns[key_] = jax.jit(grow, **jit_kw)
+        return self._decode_chunk_fns[key_]
+
     def _prefill_at_fn(self, T: int):
         """Chunk prefill at a running cache offset (used by `ChatSession`):
         forward T tokens whose absolute start is `pos`, write their KV into
@@ -863,6 +896,26 @@ class Generator:
     def chat_session(self) -> "ChatSession":
         """A stateful conversation handle with cross-turn KV reuse."""
         return ChatSession(self)
+
+    def serve(self, serving=None, **knobs):
+        """A paged-KV continuous-batching engine bound to this model
+        (serving/engine.py): request queue, chunked prefill interleaved
+        with batched decode, mid-batch retirement, prefix-cached blocks.
+
+        Pass a `ServingConfig`, or its fields as keywords::
+
+            engine = gen.serve(block_size=16, max_batch=8)
+            engine.add_request("r0", prompt_tokens, max_new_tokens=128)
+            results, stats = engine.run()
+        """
+        from mdi_llm_tpu.config import ServingConfig
+        from mdi_llm_tpu.serving.engine import ServingEngine
+
+        if serving is None:
+            serving = ServingConfig(**knobs)
+        elif knobs:
+            raise ValueError("pass a ServingConfig or keywords, not both")
+        return ServingEngine(self, serving)
 
 
 
@@ -1023,7 +1076,9 @@ class ChatSession:
         """Ensure the cache covers `needed` slots: grow geometrically (at
         least doubling, 256-slot granularity) and copy existing entries into
         the leading corner — dynamic_update_slice at the origin is layout-
-        agnostic in which axis is the sequence."""
+        agnostic in which axis is the sequence.  The grow/copy runs as one
+        jitted call with the OLD buffer donated (`Generator._grow_kv_fn`),
+        so growth no longer holds two live KV caches."""
         gen = self.gen
         if self._cache_len >= needed:
             return
@@ -1031,18 +1086,14 @@ class ChatSession:
             gen.max_seq_length,
             max(_cache_bucket(needed), 2 * self._cache_len),
         )
-        fresh = gen._place_kv(
-            transformer.init_kv_cache(gen.cfg, 1, new_len, dtype=gen.cache_dtype)
-        )
         old = self._kvbox[0]
-        if old is not None and self._pos > 0:
-            fresh = jax.tree_util.tree_map(
-                lambda big, small: jax.lax.dynamic_update_slice(
-                    big, small.astype(big.dtype), (0,) * big.ndim
-                ),
-                fresh, old,
+        if old is None or self._pos == 0:
+            self._kvbox[0] = gen._place_kv(
+                transformer.init_kv_cache(gen.cfg, 1, new_len, dtype=gen.cache_dtype)
             )
-        self._kvbox[0] = fresh
+        else:
+            self._kvbox[0] = None  # donated to the grow fn
+            self._kvbox[0] = gen._grow_kv_fn(new_len)(old)
         self._cache_len = new_len
 
     def _spec_raw_stream(

@@ -7,6 +7,7 @@ from mdi_llm_tpu.models.transformer import (
     run_blocks,
     init_params,
     init_kv_cache,
+    init_paged_kv_cache,
     count_params,
     cast_params,
     slice_blocks,
@@ -19,6 +20,7 @@ __all__ = [
     "run_blocks",
     "init_params",
     "init_kv_cache",
+    "init_paged_kv_cache",
     "count_params",
     "cast_params",
     "slice_blocks",

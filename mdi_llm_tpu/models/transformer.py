@@ -190,6 +190,10 @@ def attention_forward(
     sp_meta: Optional[Tuple] = None,  # sp inference: (k_pos (B, C) absolute
     # slot positions of the LOCAL cache shard, cache_off scalar local write
     # offset, write_on scalar — this device owns the decode token)
+    paged_tables: Optional[jnp.ndarray] = None,  # (B, max_blocks) block
+    # tables: k/v caches are the POOLED (num_blocks, block_size, G, hs)
+    # layout and reads/writes resolve through the table (serving engine)
+    paged_kernel: Optional[bool] = None,  # None → auto (TPU, decode step)
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     B, T, D = x.shape
     qkv = linear(x, p["qkv"])
@@ -209,6 +213,22 @@ def attention_forward(
         k = jnp.concatenate(
             [apply_rope(k[..., :n_elem], cos_b, sin_b), k[..., n_elem:]], axis=-1
         )
+
+    if paged_tables is not None:
+        # serving path: pooled block cache, reads/writes through the table
+        from mdi_llm_tpu.ops.paged_attention import paged_attention, paged_update
+
+        if k_cache is None:
+            raise ValueError("paged attention requires the pooled KV cache")
+        k_cache, v_cache = paged_update(
+            k_cache, v_cache, k.swapaxes(1, 2), v.swapaxes(1, 2),
+            paged_tables, pos,
+        )
+        y = paged_attention(
+            q, k_cache, v_cache, paged_tables, pos, use_kernel=paged_kernel
+        )
+        y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size)
+        return linear(y.astype(x.dtype), p["proj"]), k_cache, v_cache
 
     if sp_axis is not None and k_cache is not None:
         # sequence-sharded KV cache (sp inference): the cache shard holds
@@ -309,6 +329,8 @@ def block_forward(
     sp_meta: Optional[Tuple] = None,
     moe_impl=None,
     collect_moe_aux: bool = False,
+    paged_tables: Optional[jnp.ndarray] = None,
+    paged_kernel: Optional[bool] = None,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
     parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms.
@@ -319,6 +341,7 @@ def block_forward(
     att, k_cache, v_cache = attention_forward(
         cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis,
         fresh_prefill, use_flash, sp_meta,
+        paged_tables=paged_tables, paged_kernel=paged_kernel,
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
@@ -357,6 +380,8 @@ def run_blocks(
     moe_impl=None,
     unroll: int = 1,
     collect_moe_aux: bool = False,
+    paged_tables: Optional[jnp.ndarray] = None,
+    paged_kernel: Optional[bool] = None,
 ):
     # returns (x, kv), or (x, kv, aux_sum) under collect_moe_aux
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
@@ -410,6 +435,7 @@ def run_blocks(
             cfg, layer_p, carry, pos, cos, sin, k_c, v_c, input_pos, sp_axis,
             fresh_prefill=fresh_prefill, use_flash=use_flash, sp_meta=sp_meta,
             moe_impl=moe_impl,
+            paged_tables=paged_tables, paged_kernel=paged_kernel,
         )
         return y, (k_c, v_c)
 
@@ -460,6 +486,8 @@ def forward(
     moe_impl=None,
     unroll: int = 1,
     collect_moe_aux: bool = False,
+    paged_tables: Optional[jnp.ndarray] = None,
+    paged_kernel: Optional[bool] = None,
 ):
     # returns (logits, kv), or (logits, kv, aux_sum) under collect_moe_aux
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
@@ -472,6 +500,10 @@ def forward(
     `generation.py`).  With `sp_axis` (inside a shard_map over that axis),
     `tokens` is the LOCAL sequence chunk and `input_pos` its absolute start —
     attention runs as ring attention over the distributed sequence.
+
+    With `paged_tables` (serving engine), `kv` is the POOLED block cache
+    from `init_paged_kv_cache` and every read/write resolves through the
+    per-sequence block tables (ops/paged_attention.py).
 
     `fresh_prefill` (caller contract: input_pos == 0, cache empty) attends
     over the chunk itself rather than the cache buffer, enabling the Pallas
@@ -491,6 +523,7 @@ def forward(
         sp_axis=sp_axis, fresh_prefill=fresh_prefill, use_flash=use_flash,
         sp_meta=sp_meta, moe_impl=moe_impl, unroll=unroll,
         collect_moe_aux=collect_moe_aux,
+        paged_tables=paged_tables, paged_kernel=paged_kernel,
     )
     if collect_moe_aux:
         x, kv, aux_sum = out
@@ -607,6 +640,22 @@ def init_kv_cache(
     model.py:423-447): k/v of shape (L, B, G, S, hs)."""
     L = cfg.n_layer if n_layer is None else n_layer
     shape = (L, batch_size, cfg.n_query_groups, max_seq_length, cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def init_paged_kv_cache(
+    cfg: Config,
+    num_blocks: int,
+    block_size: int,
+    dtype=jnp.bfloat16,
+    n_layer: Optional[int] = None,
+) -> KVCache:
+    """Pooled block cache for the serving engine: k/v of shape
+    (L, num_blocks, block_size, G, hs).  Block 0 is reserved by the
+    allocator (`serving.kv_pool.KVPool`) as the write-only trash block for
+    padding lanes/positions."""
+    L = cfg.n_layer if n_layer is None else n_layer
+    shape = (L, num_blocks, block_size, cfg.n_query_groups, cfg.head_size)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 

@@ -3,6 +3,7 @@
 from mdi_llm_tpu.ops.rope import build_rope_cache, apply_rope
 from mdi_llm_tpu.ops.norms import rms_norm, layer_norm
 from mdi_llm_tpu.ops.attention import multihead_attention
+from mdi_llm_tpu.ops.paged_attention import paged_attention, paged_update
 from mdi_llm_tpu.ops.sampling import sample, sample_top_p, logits_to_probs
 
 __all__ = [
@@ -11,6 +12,8 @@ __all__ = [
     "rms_norm",
     "layer_norm",
     "multihead_attention",
+    "paged_attention",
+    "paged_update",
     "sample",
     "sample_top_p",
     "logits_to_probs",

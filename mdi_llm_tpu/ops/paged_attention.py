@@ -1,0 +1,253 @@
+"""Paged decode attention over a pooled, block-table-indexed KV cache.
+
+The serving subsystem (`mdi_llm_tpu.serving`) replaces the one-contiguous-
+cache-per-run model of `generation.py` with a shared pool of fixed-width
+KV blocks: layer cache `(num_blocks, block_size, G, hs)`, and each sequence
+owns an ordered list of block ids (its *block table*).  Slot `i` of a
+sequence's table holds the KV entries for absolute positions
+`[i*block_size, (i+1)*block_size)`, so flattening the table recovers the
+contiguous layout and the absolute-position masking contract of
+`ops/attention.py` carries over unchanged — key at flattened slot `j` is
+valid iff `j <= q_pos`.
+
+Two implementations:
+
+- **lax fallback** (`_paged_attention_lax`): gather the table's blocks into
+  a per-sequence contiguous view and call `multihead_attention` on it.
+  Bit-for-bit the same softmax chain as the dense op — this is what the
+  tier-1 CPU parity tests pin down, and what guarantees the serving engine's
+  greedy streams match `Generator.generate`.
+- **Pallas kernel** (`_paged_attention_kernel`): a TPU block-table decode
+  kernel in the spirit of "Ragged Paged Attention" (PAPERS.md, arxiv
+  2604.15464): grid `(B, max_blocks)`, the block table rides in as a
+  scalar-prefetch operand so the index map DMAs exactly the blocks each
+  sequence owns (unneeded trailing grid steps remap to block 0 and skip
+  compute), online-softmax accumulation in VMEM scratch.  Semantics are
+  validated against the fallback in interpreter mode; the fallback remains
+  the default off-TPU.
+
+Writes go through `paged_update`: a scatter of the chunk's K/V into
+`(block, offset)` slots resolved through the table.  Positions past the
+table's coverage (prefill bucket padding) are redirected to block 0, which
+the serving pool reserves as a write-only trash block.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mdi_llm_tpu.ops.attention import NEG_INF, multihead_attention
+
+__all__ = ["paged_attention", "paged_update", "gather_paged_kv"]
+
+
+def paged_update(
+    k_pool: jnp.ndarray,  # (num_blocks, block_size, G, hs)
+    v_pool: jnp.ndarray,
+    k_new: jnp.ndarray,  # (B, T, G, hs)
+    v_new: jnp.ndarray,
+    block_tables: jnp.ndarray,  # (B, max_blocks) int32
+    pos: jnp.ndarray,  # (B, T) absolute positions of the chunk's tokens
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a chunk's K/V into the pool through the block tables.
+
+    Slot for position p: block `table[p // block_size]`, offset
+    `p % block_size`.  Positions whose block index falls outside the table
+    (bucket padding past the sequence budget) write to block 0 — the pool's
+    reserved trash block — so padding can never corrupt a live block.
+    """
+    MB = block_tables.shape[1]
+    BS = k_pool.shape[1]
+    idx = pos // BS
+    blk = jnp.take_along_axis(block_tables, jnp.clip(idx, 0, MB - 1), axis=1)
+    blk = jnp.where(idx < MB, blk, 0)
+    off = pos % BS
+    k_pool = k_pool.at[blk, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[blk, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def gather_paged_kv(
+    pool: jnp.ndarray,  # (num_blocks, block_size, G, hs)
+    block_tables: jnp.ndarray,  # (B, max_blocks)
+) -> jnp.ndarray:
+    """Materialize each sequence's contiguous (B, G, S, hs) view,
+    S = max_blocks * block_size.  Flattened slot j holds absolute position
+    j by the table-layout contract."""
+    g = pool[block_tables]  # (B, MB, BS, G, hs)
+    B, MB, BS, G, hs = g.shape
+    return g.reshape(B, MB * BS, G, hs).transpose(0, 2, 1, 3)
+
+
+def _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale):
+    k = gather_paged_kv(k_pool, block_tables)
+    v = gather_paged_kv(v_pool, block_tables)
+    # identical masking/softmax to the dense op: slot j valid iff j <= q_pos
+    return multihead_attention(q, k, v, q_pos, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel path (TPU): block-table decode, one query token per sequence
+# ---------------------------------------------------------------------------
+
+# import guarded so a stripped jax build without pallas still serves the
+# lax fallback (pallas itself imports fine on plain CPU)
+try:  # pragma: no cover - import guard
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,  # (B, MB) int32
+    lens_ref,  # (B,) int32 — valid KV length per sequence (q_pos + 1)
+    # blocks
+    q_ref,  # (1, n_head, hs)
+    k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
+    v_ref,
+    o_ref,  # (1, n_head, hs)
+    # scratch
+    m_ref,  # (n_head, 128) f32 running max (lane-broadcast scalar)
+    l_ref,  # (n_head, 128) f32 running denominator
+    acc_ref,  # (n_head, hs) f32 running numerator
+    *,
+    block_size: int,
+    n_groups: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    n_live = lens_ref[b]
+
+    @pl.when(i * block_size < n_live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (n_head, hs)
+        k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
+        v = v_ref[0].astype(jnp.float32)
+        n_head, hs = q.shape
+        q_per_kv = n_head // n_groups
+        qg = q.reshape(n_groups, q_per_kv, hs)
+        # (G, q_per_kv, BS) logits; batch dim G maps heads onto their group
+        s = jax.lax.dot_general(
+            qg,
+            k.transpose(1, 2, 0),  # (G, hs, BS)
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        jpos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2
+        )
+        s = jnp.where(jpos < n_live, s, NEG_INF)
+        s = s.reshape(n_head, block_size)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # (n_head, BS)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.reshape(n_groups, q_per_kv, block_size),
+            v.transpose(1, 0, 2),  # (G, BS, hs)
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(n_head, hs)
+        acc_ref[...] = corr * acc_ref[...] + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _paged_attention_kernel(
+    q, k_pool, v_pool, block_tables, q_pos, scale, interpret=False
+):
+    """q: (B, n_head, 1, hs) → (B, n_head, 1, hs)."""
+    B, n_head, Tq, hs = q.shape
+    assert Tq == 1, "kernel path is decode-only (Tq == 1)"
+    NB, BS, G, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    lens = (q_pos[:, 0] + 1).astype(jnp.int32)
+    tables = block_tables.astype(jnp.int32)
+
+    def kv_index(bidx, i, tables_ref, lens_ref):
+        # unneeded trailing blocks remap to block 0: the DMA still happens
+        # (the grid is static) but never re-reads a far block
+        needed = i * BS < lens_ref[bidx]
+        return (jnp.where(needed, tables_ref[bidx, i], 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MB),
+        in_specs=[
+            pl.BlockSpec((1, n_head, hs), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, BS, G, hs), kv_index),
+            pl.BlockSpec((1, BS, G, hs), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, n_head, hs), lambda b, i, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_head, 128), jnp.float32),
+            pltpu.VMEM((n_head, 128), jnp.float32),
+            pltpu.VMEM((n_head, hs), jnp.float32),
+        ],
+    )
+    kern = functools.partial(
+        _decode_kernel, block_size=BS, n_groups=G, scale=scale
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_head, hs), q.dtype),
+        interpret=interpret,
+    )(tables, lens, q[:, :, 0, :], k_pool, v_pool)
+    return out[:, :, None, :]
+
+
+def paged_attention(
+    q: jnp.ndarray,  # (B, n_head, Tq, hs)
+    k_pool: jnp.ndarray,  # (num_blocks, block_size, G, hs)
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # (B, max_blocks) int32
+    q_pos: jnp.ndarray,  # (B, Tq) absolute query positions
+    scale: Optional[float] = None,
+    use_kernel: Optional[bool] = None,  # None → auto (TPU backend, decode)
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal GQA/MQA attention through per-sequence block tables.
+
+    Returns (B, n_head, Tq, hs).  Tq > 1 (chunked prefill attending through
+    the pool) always takes the gather fallback; the kernel covers the hot
+    Tq == 1 decode step.
+    """
+    hs = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (hs**0.5)
+    if use_kernel is None:
+        use_kernel = (
+            _HAS_PALLAS
+            and jax.default_backend() == "tpu"
+            and q.shape[2] == 1
+        )
+    if use_kernel and q.shape[2] == 1 and _HAS_PALLAS:
+        return _paged_attention_kernel(
+            q, k_pool, v_pool, block_tables, q_pos, scale, interpret=interpret
+        )
+    return _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale)

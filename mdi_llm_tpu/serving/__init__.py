@@ -1,0 +1,15 @@
+"""Paged-KV serving subsystem: block pool, continuous-batching scheduler,
+and the `ServingEngine` request loop (see docs/perf.md "Serving")."""
+
+from mdi_llm_tpu.serving.kv_pool import KVPool
+from mdi_llm_tpu.serving.scheduler import Request, Scheduler, SequenceState
+from mdi_llm_tpu.serving.engine import ServingEngine, ServingStats
+
+__all__ = [
+    "KVPool",
+    "Request",
+    "Scheduler",
+    "SequenceState",
+    "ServingEngine",
+    "ServingStats",
+]

@@ -1,0 +1,353 @@
+"""Continuous-batching serving engine over the paged KV pool.
+
+Request-level scheduling on top of the existing jitted forward machinery:
+where `Generator.generate` allocates one contiguous `[B, S]` cache per call
+and holds the batch shape for the whole run, `ServingEngine` keeps ONE
+pooled block cache (`transformer.init_paged_kv_cache`) shared by every
+in-flight request, admits requests from a queue into `max_batch` decode
+slots, runs chunked prefill interleaved with batched decode, retires
+finished sequences mid-batch, and reuses blocks across requests (including
+copy-free prefix sharing for common prompt heads — chat system prompts,
+`utils/prompts.py` styles).
+
+Greedy parity contract (pinned by tests/test_serving.py): because the
+paged attention op masks strictly by absolute position and its lax
+fallback runs the exact `ops/attention.py` softmax chain, the per-request
+greedy token streams are identical to sequential `Generator.generate`
+calls — scheduling order, chunking, lane assignment and block placement
+are all invisible to the math.
+
+Device dispatch shapes stay bounded: prefill chunks use the same
+power-of-two buckets as `generation.py` (one compile per bucket) at B=1,
+and decode is a fixed `(max_batch, 1)` step (dead lanes ride along as
+padding writing into the pool's trash block).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mdi_llm_tpu.config import ServingConfig
+from mdi_llm_tpu.generation import (
+    Generator,
+    _bucket,
+    detect_stop_tokens,
+    find_eot,
+)
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.ops.sampling import sample
+from mdi_llm_tpu.serving.kv_pool import KVPool
+from mdi_llm_tpu.serving.scheduler import Request, Scheduler, SequenceState
+
+__all__ = ["ServingEngine", "ServingStats"]
+
+
+@dataclass
+class ServingStats:
+    tokens_generated: int = 0
+    prefill_tokens: int = 0
+    prefill_chunks: int = 0
+    decode_steps: int = 0
+    requests_finished: int = 0
+    preemptions: int = 0
+    prefix_cache_hits: int = 0  # blocks reused copy-free
+    wall_s: float = 0.0
+    decode_s: float = 0.0
+    prefill_s: float = 0.0
+    # block-pool utilization, sampled at every decode step as a running
+    # aggregate (a long-lived engine must not grow per-step state)
+    _kv_util_sum: float = 0.0
+    _kv_util_n: int = 0
+    _kv_util_peak: float = 0.0
+
+    def observe_kv_utilization(self, util: float) -> None:
+        self._kv_util_sum += util
+        self._kv_util_n += 1
+        self._kv_util_peak = max(self._kv_util_peak, util)
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.tokens_generated / self.wall_s if self.wall_s else 0.0
+
+    @property
+    def kv_utilization_mean(self) -> float:
+        return self._kv_util_sum / self._kv_util_n if self._kv_util_n else 0.0
+
+    @property
+    def kv_utilization_peak(self) -> float:
+        return self._kv_util_peak
+
+
+class ServingEngine:
+    """Paged-KV continuous-batching loop bound to one `Generator`'s model.
+
+    Build via `Generator.serve(...)`.  Typical use::
+
+        engine = gen.serve(block_size=16, max_batch=8)
+        engine.add_request("a", prompt_tokens, max_new_tokens=128)
+        results, stats = engine.run()
+    """
+
+    def __init__(self, gen: Generator, serving: ServingConfig):
+        if gen.mesh is not None:
+            raise ValueError(
+                "ServingEngine is single-device for now (the pooled block "
+                "cache has no sharding layout); build the Generator without "
+                "a mesh"
+            )
+        self.gen = gen
+        self.cfg = serving
+        bs = serving.block_size
+        if bs < 1:
+            raise ValueError("block_size must be positive")
+        self.max_seq_length = gen.max_seq_length
+        # blocks per sequence table: full coverage of the engine window
+        self.max_blocks_per_seq = -(-self.max_seq_length // bs)
+        num_blocks = serving.max_blocks
+        if num_blocks is None:
+            # every slot can grow to the full window, plus the trash block
+            num_blocks = 1 + serving.max_batch * self.max_blocks_per_seq
+        self.pool = KVPool(num_blocks, bs, prefix_caching=serving.prefix_caching)
+        self.scheduler = Scheduler(
+            self.pool, serving.max_batch, serving.prefill_chunk,
+            self.max_seq_length,
+        )
+        self._kv = transformer.init_paged_kv_cache(
+            gen.cfg, num_blocks, bs, dtype=gen.cache_dtype
+        )
+        self._fns: Dict[Any, Any] = {}
+        self.stats = ServingStats()
+        self._results: Dict[str, List[int]] = {}
+        self._stream_cb = None
+
+    # -- compiled phases -----------------------------------------------------
+
+    def _prefill_fn(self, T: int):
+        key_ = ("prefill", T)
+        if key_ not in self._fns:
+            gen = self.gen
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def prefill(params, tokens, kv, tables, pos0, true_len):
+                logits, kv = transformer.forward(
+                    gen.cfg, params, tokens, pos0, kv=kv, rope=gen.rope,
+                    moe_impl=gen._moe_impl, paged_tables=tables,
+                    paged_kernel=self.cfg.use_kernel,
+                )
+                last = jnp.take_along_axis(
+                    logits, (true_len - 1)[:, None, None], axis=1
+                )[:, 0]
+                return last, kv
+
+            self._fns[key_] = prefill
+        return self._fns[key_]
+
+    def _decode_fn(self, B: int):
+        key_ = ("decode", B)
+        if key_ not in self._fns:
+            gen = self.gen
+
+            @partial(
+                jax.jit, donate_argnums=(2,),
+                static_argnames=("temperature", "top_k", "top_p"),
+            )
+            def decode(params, tok, kv, tables, input_pos, key,
+                       temperature, top_k, top_p):
+                logits, kv = transformer.forward(
+                    gen.cfg, params, tok[:, None], input_pos, kv=kv,
+                    rope=gen.rope, moe_impl=gen._moe_impl,
+                    unroll=gen.scan_unroll, paged_tables=tables,
+                    paged_kernel=self.cfg.use_kernel,
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample(
+                    logits[:, -1], sub, temperature=temperature,
+                    top_k=top_k, top_p=top_p,
+                )
+                return nxt.astype(jnp.int32), kv, key
+
+            self._fns[key_] = decode
+        return self._fns[key_]
+
+    # -- request surface -----------------------------------------------------
+
+    def add_request(
+        self,
+        rid: str,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> str:
+        """Queue a request; raises ValueError if it can never fit."""
+        self.scheduler.add(Request(
+            rid=rid, prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            stop_sequences=stop_sequences,
+        ))
+        return rid
+
+    def _table_row(self, seq: SequenceState) -> np.ndarray:
+        row = np.zeros((self.max_blocks_per_seq,), np.int32)
+        row[: len(seq.blocks)] = seq.blocks
+        return row
+
+    # -- execution -----------------------------------------------------------
+
+    def _run_prefill(self, seq: SequenceState, chunk: int) -> None:
+        t0 = time.perf_counter()
+        bs = self.pool.block_size
+        # grow the table to cover this chunk's writes (admission already
+        # reserved enough blocks, so alloc can only fail after preemptions
+        # shrank the pool guarantee — grow defensively like decode does)
+        while self.pool.blocks_needed(seq.fed + chunk) > len(seq.blocks):
+            got = self.pool.alloc(1)
+            if got is None:
+                if not self.scheduler.preempt_latest(exclude=seq):
+                    raise RuntimeError("KV pool exhausted during prefill")
+                if self.scheduler.slots[seq.slot] is not seq:
+                    return  # self-preempted; it will resume from the queue
+                continue
+            seq.blocks.extend(got)
+        Tb = min(_bucket(chunk), self.max_seq_length)
+        toks = np.zeros((1, Tb), np.int32)
+        toks[0, :chunk] = seq.tokens[seq.fed : seq.fed + chunk]
+        kv = self._kv
+        self._kv = None  # donated
+        try:
+            last, self._kv = self._prefill_fn(Tb)(
+                self.gen.params, jnp.asarray(toks), kv,
+                jnp.asarray(self._table_row(seq)[None, :]),
+                jnp.asarray([seq.fed], jnp.int32),
+                jnp.asarray([chunk], jnp.int32),
+            )
+        except Exception:
+            # keep the engine debuggable after a failed dispatch: restore
+            # the pool handle (if the donation consumed it, later use fails
+            # with jax's clear deleted-buffer error, not a paged-cache one)
+            self._kv = kv
+            raise
+        seq.fed += chunk
+        self.stats.prefill_tokens += chunk
+        self.stats.prefill_chunks += 1
+        if seq.fed >= seq.prefill_target:
+            # prompt (as far as it was actually FED) is in the pool: publish
+            # its full blocks for prefix reuse.  Only now — registering
+            # before the KV is written would let a concurrent request attend
+            # garbage — and only up to `fed`: a resumed sequence's prefill
+            # stops one token short (the pending token decodes later), so a
+            # block-aligned prompt would otherwise register a block whose
+            # last slot is still unwritten.
+            self.pool.register_prefix(
+                seq.blocks, seq.req.prompt[: seq.fed]
+            )
+            if seq.resume_tok is not None:
+                seq.next_tok = seq.resume_tok  # preserved across preemption
+            else:
+                self.gen.key, sub = jax.random.split(self.gen.key)
+                tok = sample(
+                    last, sub, temperature=self.cfg.temperature,
+                    top_k=self.cfg.top_k, top_p=self.cfg.top_p,
+                )
+                self._emit(seq, int(np.asarray(tok)[0]))
+        self.stats.prefill_s += time.perf_counter() - t0
+
+    def _emit(self, seq: SequenceState, tok: int) -> None:
+        """Append one generated token, stream it, and retire on stop/limit."""
+        seq.tokens.append(tok)
+        seq.next_tok = tok
+        self.stats.tokens_generated += 1
+        if self._stream_cb is not None:
+            self._stream_cb(seq.req.rid, tok)
+        gen_tokens = seq.generated()
+        if (
+            len(gen_tokens) >= seq.req.max_new_tokens
+            or detect_stop_tokens(gen_tokens, seq.req.stop_sequences)
+            or len(seq.tokens) >= self.max_seq_length
+        ):
+            self._finish(seq)
+
+    def _finish(self, seq: SequenceState) -> None:
+        gen_tokens = seq.generated()
+        cut = find_eot(gen_tokens, seq.req.stop_sequences)
+        self._results[seq.req.rid] = seq.tokens[: seq.n_prompt + cut]
+        self.scheduler.retire(seq)
+        self.stats.requests_finished += 1
+
+    def _run_decode(self, seqs: List[SequenceState]) -> None:
+        t0 = time.perf_counter()
+        # every live sequence needs a slot for this step's KV write; growth
+        # may preempt — drop any sequence that lost its own slot
+        live: List[SequenceState] = []
+        for seq in seqs:
+            if self.scheduler.slots[seq.slot] is seq and \
+                    self.scheduler.ensure_block_for(seq):
+                live.append(seq)
+        live = [s for s in live if self.scheduler.slots[s.slot] is s]
+        if not live:
+            return
+        B = self.scheduler.max_batch
+        tok = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
+        for seq in live:
+            tok[seq.slot] = seq.next_tok
+            pos[seq.slot] = seq.fed
+            tables[seq.slot] = self._table_row(seq)
+        kv = self._kv
+        self._kv = None  # donated
+        try:
+            nxt, self._kv, self.gen.key = self._decode_fn(B)(
+                self.gen.params, jnp.asarray(tok), kv, jnp.asarray(tables),
+                jnp.asarray(pos), self.gen.key,
+                temperature=self.cfg.temperature, top_k=self.cfg.top_k,
+                top_p=self.cfg.top_p,
+            )
+        except Exception:
+            self._kv = kv  # see _run_prefill: keep failures diagnosable
+            raise
+        nxt = np.asarray(nxt)
+        self.stats.decode_steps += 1
+        self.stats.observe_kv_utilization(self.pool.utilization)
+        for seq in live:
+            seq.fed += 1
+            self._emit(seq, int(nxt[seq.slot]))
+        self.stats.decode_s += time.perf_counter() - t0
+
+    def step(self) -> bool:
+        """Run one scheduler action; False when nothing was runnable."""
+        action = self.scheduler.next_action()
+        if action is None:
+            return False
+        if action[0] == "prefill":
+            _, seq, chunk = action
+            self._run_prefill(seq, chunk)
+        else:
+            self._run_decode(action[1])
+        return True
+
+    def run(self, stream_cb=None) -> Tuple[Dict[str, List[int]], ServingStats]:
+        """Drive the loop until every queued request finishes.  Returns
+        {rid: full token list (prompt + generation, stop-trimmed)} — the
+        same shape `Generator.generate` returns per prompt — and stats.
+
+        `stream_cb(rid, token)` fires per generated token when given.
+        """
+        self._stream_cb = stream_cb
+        t0 = time.perf_counter()
+        try:
+            while self.scheduler.has_work:
+                if not self.step():
+                    break
+        finally:
+            self.stats.preemptions = self.scheduler.preemptions
+            self.stats.prefix_cache_hits = self.pool.prefix_hits
+            self.stats.wall_s += time.perf_counter() - t0
+            self._stream_cb = None
+        return dict(self._results), self.stats

@@ -1,0 +1,162 @@
+"""Host-side block allocator for the pooled KV cache.
+
+The device side is a `(L, num_blocks, block_size, G, hs)` array pair
+(`transformer.init_paged_kv_cache`); this module owns the METADATA: which
+blocks are free, which sequence references which blocks, and — the piece
+that makes shared system prompts cheap — a hash-chain prefix cache in the
+style of vLLM's automatic prefix caching:
+
+- every FULL block of a prompt is identified by
+  `hash(parent_hash, tokens_in_block)`, so equal prompt prefixes map to
+  equal hash chains regardless of which request produced them;
+- on allocation, cached blocks matching the prompt's chain are reused by
+  refcount (copy-free: no KV bytes move);
+- on release, refcounts drop; hash-registered blocks whose count hits zero
+  stay warm in an LRU "evictable" set and only return to circulation when
+  the free list runs dry (copy-free release — nothing is zeroed or moved).
+
+Block 0 is reserved as the write-only TRASH block: padded lanes and
+bucket-padding positions scatter their garbage K/V there
+(`ops.paged_attention.paged_update`), so it is never handed out.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["KVPool"]
+
+
+class KVPool:
+    """Free-list block allocator with refcounts and hash-based prefix reuse."""
+
+    def __init__(self, num_blocks: int, block_size: int, prefix_caching: bool = True):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the trash block)")
+        if block_size < 1:
+            raise ValueError("block_size must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.prefix_caching = prefix_caching
+        # LIFO free list keeps recently-released blocks hot in HBM caches
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+        # prefix cache state
+        self._hash_to_block: Dict[int, int] = {}
+        self._block_hash: Dict[int, int] = {}  # registered full blocks only
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()  # ref==0, cached
+        self.prefix_hits = 0  # blocks reused copy-free
+        self.prefix_queries = 0  # full blocks looked up
+
+    # -- capacity ------------------------------------------------------------
+
+    @property
+    def available(self) -> int:
+        """Blocks allocatable right now (free + evictable cached)."""
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def used(self) -> int:
+        """Blocks referenced by live sequences."""
+        return sum(1 for c in self._ref.values() if c > 0)
+
+    @property
+    def utilization(self) -> float:
+        """Fraction of allocatable blocks held by live sequences."""
+        return self.used / max(1, self.num_blocks - 1)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    # -- allocation ----------------------------------------------------------
+
+    def _take(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._evictable:  # evict the least-recently-released cached block
+            blk, _ = self._evictable.popitem(last=False)
+            h = self._block_hash.pop(blk)
+            del self._hash_to_block[h]
+            return blk
+        return None
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take `n` fresh blocks (refcount 1 each); None if short — the
+        caller must not have mutated state (all-or-nothing)."""
+        if n > self.available:
+            return None
+        out = []
+        for _ in range(n):
+            blk = self._take()
+            assert blk is not None
+            self._ref[blk] = 1
+            out.append(blk)
+        return out
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block.  Copy-free: registered blocks whose
+        refcount reaches zero stay warm for prefix reuse; unregistered ones
+        go straight back to the free list."""
+        for blk in blocks:
+            c = self._ref.get(blk, 0) - 1
+            if c > 0:
+                self._ref[blk] = c
+                continue
+            self._ref.pop(blk, None)
+            if blk in self._block_hash:
+                self._evictable[blk] = None
+                self._evictable.move_to_end(blk)
+            else:
+                self._free.append(blk)
+
+    # -- prefix caching ------------------------------------------------------
+
+    @staticmethod
+    def chain_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+        """One hash per FULL block, each chained on its parent so a block's
+        identity covers the whole prefix up to and including it."""
+        hashes: List[int] = []
+        parent = 0
+        for i in range(len(tokens) // block_size):
+            parent = hash((parent, tuple(tokens[i * block_size : (i + 1) * block_size])))
+            hashes.append(parent)
+        return hashes
+
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached block-chain prefix of `tokens`, with a reference
+        taken on every matched block.  Returns (blocks, n_cached_tokens).
+
+        At most `(len(tokens) - 1) // block_size` blocks are matched: the
+        prompt's last token is always recomputed, and keeping the cached
+        span block-aligned means the requester's first write lands in a
+        block it owns exclusively (no copy-on-write machinery needed).
+        """
+        if not self.prefix_caching:
+            return [], 0
+        max_blocks = max(0, (len(tokens) - 1)) // self.block_size
+        matched: List[int] = []
+        for h in self.chain_hashes(tokens, self.block_size)[:max_blocks]:
+            self.prefix_queries += 1
+            blk = self._hash_to_block.get(h)
+            if blk is None:
+                break
+            self.prefix_hits += 1
+            self._ref[blk] = self._ref.get(blk, 0) + 1
+            self._evictable.pop(blk, None)
+            matched.append(blk)
+        return matched, len(matched) * self.block_size
+
+    def register_prefix(self, blocks: Sequence[int], tokens: Sequence[int]) -> None:
+        """Record the hash chain for the full blocks of `tokens`, making
+        them reusable by future requests.  Blocks already registered under
+        the same hash keep the existing mapping (first writer wins)."""
+        if not self.prefix_caching:
+            return
+        for blk, h in zip(blocks, self.chain_hashes(tokens, self.block_size)):
+            if h in self._hash_to_block:
+                continue
+            if blk in self._block_hash:  # block already identifies another chain
+                continue
+            self._hash_to_block[h] = blk
+            self._block_hash[blk] = h

@@ -1,0 +1,260 @@
+"""Continuous-batching request scheduler.
+
+Policy layer between the request queue and the device loop
+(`serving.engine.ServingEngine`): FCFS admission into a fixed set of decode
+slots, chunked prefill interleaved with batched decode, mid-batch
+retirement, and recompute-style preemption when the block pool runs dry.
+
+The scheduler never touches device arrays — it owns `SequenceState`
+bookkeeping (token lists, block tables, feed positions) and the `KVPool`
+accounting, and hands the engine one action at a time:
+
+    ("prefill", seq, chunk_len)   feed the next `chunk_len` tokens of `seq`
+    ("decode", [seqs])            one batched decode step over the live slots
+    None                          nothing runnable (queue empty or blocked)
+
+Feed-position invariants (`SequenceState`):
+- `fed` tokens have their K/V in the pool; the next token to feed is
+  `tokens[fed]` at absolute position `fed`.
+- prefill phase: `fed < prefill_target`; on completion the engine samples
+  the first output token from the chunk's last logits (fresh requests) or
+  restores the preserved `resume_tok` (preempted requests).
+- decode phase: `fed == len(tokens) - 1` — exactly the final sampled token
+  is pending, matching `Generator.generate`'s loop.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Sequence, Tuple
+
+from mdi_llm_tpu.serving.kv_pool import KVPool
+
+__all__ = ["Request", "SequenceState", "Scheduler"]
+
+
+@dataclass
+class Request:
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int
+    stop_sequences: Sequence[Sequence[int]] = ()
+
+
+class SequenceState:
+    """One admitted request's feed/block bookkeeping."""
+
+    def __init__(self, req: Request, blocks: List[int], n_cached: int,
+                 slot: int, resume_tokens: Optional[List[int]] = None):
+        self.req = req
+        # full logical token list (prompt + generated so far)
+        self.tokens: List[int] = list(resume_tokens or req.prompt)
+        self.blocks = blocks  # shared cached prefix + exclusively owned
+        self.n_cached = n_cached  # tokens covered by reused prefix blocks
+        self.fed = n_cached  # tokens whose K/V is in the pool
+        self.slot = slot
+        # resumed sequences already know their pending token; fresh ones
+        # sample it from the prefill logits
+        self.resume_tok: Optional[int] = (
+            self.tokens[-1] if resume_tokens else None
+        )
+        self.prefill_target = (
+            len(self.tokens) - 1 if resume_tokens else len(self.tokens)
+        )
+        self.next_tok: Optional[int] = None  # sampled, not yet fed
+        # a fully-prefix-cached resume needs no prefill at all: the pending
+        # token is restored immediately so next_action sees it decode-ready
+        if resume_tokens and self.fed >= self.prefill_target:
+            self.next_tok = self.resume_tok
+        self.done = False
+        self.admit_order = -1  # stamped by the scheduler at admission
+
+    @property
+    def n_prompt(self) -> int:
+        return len(self.req.prompt)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.tokens) - self.n_prompt
+
+    @property
+    def needs_prefill(self) -> bool:
+        return self.fed < self.prefill_target
+
+    def generated(self) -> List[int]:
+        return self.tokens[self.n_prompt:]
+
+
+class Scheduler:
+    def __init__(self, pool: KVPool, max_batch: int, prefill_chunk: int,
+                 max_seq_length: int):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.pool = pool
+        self.max_batch = max_batch
+        self.prefill_chunk = max(1, prefill_chunk)
+        self.max_seq_length = max_seq_length
+        self.waiting: Deque[Request] = deque()
+        # preempted sequences resume before fresh admissions (they hold
+        # progress the pool already paid for once)
+        self.preempted: Deque[Tuple[Request, List[int]]] = deque()
+        self.slots: List[Optional[SequenceState]] = [None] * max_batch
+        self.finished: List[SequenceState] = []
+        self._decode_turn = False  # prefill/decode interleave flip-flop
+        self._admit_counter = 0  # admission recency for preemption order
+        self.preemptions = 0
+
+    # -- queue ---------------------------------------------------------------
+
+    def add(self, req: Request) -> None:
+        total = len(req.prompt) + req.max_new_tokens
+        if len(req.prompt) < 1:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        if req.max_new_tokens < 1:
+            # 0 would break the generate() parity contract: prefill always
+            # samples one token before the generated-length check fires (and
+            # with max_new >= 1 the add-time footprint check below also
+            # covers admission's blocks_needed(prompt + 1) reservation)
+            raise ValueError(f"request {req.rid}: max_new_tokens must be >= 1")
+        if total > self.max_seq_length:
+            raise ValueError(
+                f"request {req.rid}: prompt+max_new_tokens {total} exceeds "
+                f"max_seq_length {self.max_seq_length}"
+            )
+        # worst-case block footprint must fit the pool even running alone
+        if self.pool.blocks_needed(total) > self.pool.num_blocks - 1:
+            raise ValueError(
+                f"request {req.rid}: needs {self.pool.blocks_needed(total)} "
+                f"blocks, pool has {self.pool.num_blocks - 1}"
+            )
+        self.waiting.append(req)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(
+            self.waiting or self.preempted
+            or any(s is not None for s in self.slots)
+        )
+
+    def running(self) -> List[SequenceState]:
+        return [s for s in self.slots if s is not None]
+
+    # -- admission -----------------------------------------------------------
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _try_admit_one(self, req: Request,
+                       resume_tokens: Optional[List[int]]) -> Optional[SequenceState]:
+        slot = self._free_slot()
+        if slot is None:
+            return None
+        tokens = resume_tokens or req.prompt
+        cached, n_cached = self.pool.match_prefix(tokens)
+        # cover every prefill write plus the first decode write
+        target = len(tokens) - 1 if resume_tokens else len(tokens)
+        need = self.pool.blocks_needed(target + 1) - len(cached)
+        owned = self.pool.alloc(max(0, need))
+        if owned is None:
+            self.pool.release(cached)
+            return None
+        seq = SequenceState(req, cached + owned, n_cached, slot,
+                            resume_tokens=resume_tokens)
+        seq.admit_order = self._admit_counter
+        self._admit_counter += 1
+        self.slots[slot] = seq
+        return seq
+
+    def admit(self) -> List[SequenceState]:
+        """FCFS admission (preempted first): stop at the first request that
+        does not fit — head-of-line order keeps starvation impossible."""
+        admitted = []
+        while self.preempted:
+            req, toks = self.preempted[0]
+            seq = self._try_admit_one(req, toks)
+            if seq is None:
+                return admitted
+            self.preempted.popleft()
+            admitted.append(seq)
+        while self.waiting:
+            seq = self._try_admit_one(self.waiting[0], None)
+            if seq is None:
+                return admitted
+            self.waiting.popleft()
+            admitted.append(seq)
+        return admitted
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def retire(self, seq: SequenceState) -> None:
+        """Mid-batch retirement: free the slot and the blocks (copy-free —
+        prefix-registered blocks stay warm in the pool's cached set)."""
+        seq.done = True
+        self.slots[seq.slot] = None
+        self.pool.release(seq.blocks)
+        seq.blocks = []
+        self.finished.append(seq)
+
+    def preempt_latest(self, exclude: Optional[SequenceState] = None) -> bool:
+        """Recompute-style preemption: kick the most recently admitted
+        sequence back to the queue (its tokens re-prefill on resume)."""
+        victims = [s for s in self.running() if s is not exclude]
+        if not victims:
+            # fall back to self-preemption: the caller's own sequence yields
+            victims = self.running()
+        if not victims:
+            return False
+        # most recently ADMITTED (not highest slot index — slots churn):
+        # the newest sequence has the least paid-for KV to recompute
+        seq = max(victims, key=lambda s: s.admit_order)
+        self.slots[seq.slot] = None
+        self.pool.release(seq.blocks)
+        seq.blocks = []
+        # resume from the full token list; the pending token rides along
+        toks = list(seq.tokens)
+        if seq.next_tok is not None and (not toks or toks[-1] != seq.next_tok):
+            toks.append(seq.next_tok)
+        self.preempted.appendleft((seq.req, toks))
+        self.preemptions += 1
+        return True
+
+    def ensure_block_for(self, seq: SequenceState) -> bool:
+        """Grow a decoding sequence's table to cover its next write (one
+        block at a time); preempt others until it fits.  False if the
+        sequence itself was preempted."""
+        while self.pool.blocks_needed(seq.fed + 1) > len(seq.blocks):
+            got = self.pool.alloc(1)
+            if got is not None:
+                seq.blocks.extend(got)
+                continue
+            if not self.preempt_latest(exclude=seq):
+                raise RuntimeError("KV pool exhausted with nothing to preempt")
+            if self.slots[seq.slot] is not seq:  # self-preempted
+                return False
+        return True
+
+    # -- action selection ----------------------------------------------------
+
+    def next_action(self):
+        """One step of the continuous-batching policy: admit whatever fits,
+        then alternate prefill chunks with decode steps while both kinds of
+        work exist (so a long prompt cannot stall live decodes)."""
+        self.admit()
+        prefilling = [s for s in self.running() if s.needs_prefill]
+        decoding = [
+            s for s in self.running()
+            if not s.needs_prefill and s.next_tok is not None
+        ]
+        if prefilling and (not decoding or not self._decode_turn):
+            self._decode_turn = True
+            seq = prefilling[0]
+            chunk = min(self.prefill_chunk, seq.prefill_target - seq.fed)
+            return ("prefill", seq, chunk)
+        if decoding:
+            self._decode_turn = False
+            return ("decode", decoding)
+        return None

@@ -336,6 +336,28 @@ def test_shared_prompt_prefill_matches_per_lane(small_model):
     assert stopped == [single[0][: 3 + 2]] * 3
 
 
+def test_shared_prefill_auto_disables_when_sampling(small_model):
+    """The rule: shared_prefill=None (default) engages the broadcast fast
+    path only for greedy decoding.  At temperature > 0 the default must
+    produce draw-identical streams to the per-lane path (same RNG seed),
+    while an explicit shared_prefill=True still opts the fast path in."""
+    cfg, params = small_model
+    prompts = [[7, 3, 9]] * 3
+    auto = Generator(cfg, params, cache_dtype=jnp.float32, rng_seed=11)
+    forced_off = Generator(cfg, params, cache_dtype=jnp.float32, rng_seed=11)
+    got_auto, _ = auto.generate(prompts, 8, temperature=0.9, top_k=20)
+    got_off, _ = forced_off.generate(
+        prompts, 8, temperature=0.9, top_k=20, shared_prefill=False
+    )
+    assert got_auto == got_off, "sampling default must match per-lane draws"
+    # explicit opt-in keeps working (distribution preserved, shapes sane)
+    opt_in = Generator(cfg, params, cache_dtype=jnp.float32, rng_seed=11)
+    got_on, _ = opt_in.generate(
+        prompts, 8, temperature=0.9, top_k=20, shared_prefill=True
+    )
+    assert len(got_on) == 3 and all(len(o) == 3 + 8 for o in got_on)
+
+
 def test_shared_prompt_numpy_prompts_and_opt_out(small_model):
     """np.ndarray prompts must batch fine (duck-typed Sequence[int]) and
     shared_prefill=False must force the per-lane prefill path."""
