@@ -46,6 +46,18 @@ REFERENCE_TOKENS_PER_S = 7.0  # 3×Jetson TX2, TinyLlama, from the plot
 JETSON_8B_TOKENS_PER_S = 40.0  # stated stand-in: AGX Orin Llama-3-8B int4
 NORTH_STAR_MULTIPLE = 1.5  # BASELINE.md: >=1.5x the Jetson-class baseline
 
+# The CompileGuard for the in-flight direct measurement (run_direct wraps
+# every mode in one).  Modes call _mark_warm() at their warmup boundary;
+# decode rows FAIL on any post-warmup recompile (the mdi-lint contract:
+# the steady state must never build a new executable — docs/analysis.md),
+# and every row records the counts in detail.compiles.
+_GUARD = None
+
+
+def _mark_warm():
+    if _GUARD is not None:
+        _GUARD.mark_warm()
+
 
 def baseline_for(model: str) -> float:
     return JETSON_8B_TOKENS_PER_S if "8b" in model.lower() else REFERENCE_TOKENS_PER_S
@@ -194,6 +206,7 @@ def run_train(args):
     # outputs — so each iteration below is device-synchronized and the
     # wall clock measures completed steps, not async dispatch
     loss = trainer.train_step(xs[0], ys[0])  # compile + warmup
+    _mark_warm()
     # ExitStack so an exception inside the timed loop cannot leak an open
     # profiler trace (a dangling trace wedges later jax.profiler sessions)
     with contextlib.ExitStack() as stack:
@@ -408,6 +421,10 @@ def run_serve(args):
     for rid, prompt, new in trace[: min(len(trace), args.batch)]:
         warm.add_request(rid, prompt, min(new, 8))
     warm.run()
+    # warm-only covers the compile shapes the PREFIX exercised; the full
+    # trace may still hit fresh prefill buckets, so serve rows record
+    # compile counts without enforcing zero (decode rows enforce)
+    _mark_warm()
 
     engine = build_engine()
     for rid, prompt, new in trace:
@@ -518,6 +535,7 @@ def run_decode(args):
     # (prompt+max_new bucket), so a shorter warmup would compile a different
     # cache shape and the timed run would recompile inside the measurement
     engine.generate(prompts, args.new_tokens, temperature=0.0, **kwargs)
+    _mark_warm()  # the timed region below must not compile ANYTHING
     # ExitStack: see run_train — no leaked profiler trace on a failed run
     with contextlib.ExitStack() as stack:
         if args.profile:
@@ -584,6 +602,7 @@ def _enable_compile_cache():
 
 
 def run_direct(args):
+    global _GUARD
     if args.backend == "cpu":
         import jax
 
@@ -591,17 +610,34 @@ def run_direct(args):
     _enable_compile_cache()
     if args.chunk is None:
         args.chunk = 16 if args.pipeline else 256
-    if args.probe:
-        return run_probe()
-    if args.mode == "prefill":
-        return run_prefill(args)
-    if args.mode == "train":
-        if args.pipeline:
-            raise SystemExit("--mode train benches the unmeshed Trainer; drop --pipeline")
-        return run_train(args)
-    if args.mode == "serve":
-        return run_serve(args)
-    return run_decode(args)
+    from mdi_llm_tpu.utils.profiling import CompileGuard
+
+    _GUARD = CompileGuard(label=f"bench:{'probe' if args.probe else args.mode}")
+    try:
+        with _GUARD:
+            if args.probe:
+                out = run_probe()
+            elif args.mode == "prefill":
+                out = run_prefill(args)
+            elif args.mode == "train":
+                if args.pipeline:
+                    raise SystemExit(
+                        "--mode train benches the unmeshed Trainer; drop --pipeline"
+                    )
+                out = run_train(args)
+            elif args.mode == "serve":
+                out = run_serve(args)
+            else:
+                out = run_decode(args)
+        out.setdefault("detail", {})["compiles"] = _GUARD.summary()
+        if args.mode == "decode" and not args.probe:
+            # the steady-state contract: a timed decode region that traces
+            # even once is measuring compiles, not tokens — fail the row
+            # loudly (RecompileError) rather than record a poisoned number
+            _GUARD.expect_clean()
+        return out
+    finally:
+        _GUARD = None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,32 @@
+"""`mdi-lint`: JAX/TPU-aware static analysis for this repo's hot paths.
+
+The serving story (recurrent pipeline parallelism, paged-KV continuous
+batching) only holds while every decode path stays inside a single compiled
+XLA program.  One stray Python branch on a tracer, an undonated KV buffer,
+or a hidden host sync silently turns "as fast as the hardware allows" into
+per-token recompiles and device<->host ping-pong.  The rules here encode
+those invariants; the runtime companion (`utils.profiling.CompileGuard`)
+proves the steady state on real traces.
+
+Usage::
+
+    mdi-lint mdi_llm_tpu/                  # or: python -m mdi_llm_tpu.analysis
+    mdi-lint --list-rules
+    mdi-lint mdi_llm_tpu/ --update-baseline
+
+Findings are suppressed per line with ``# mdi-lint: disable=rule-name`` (or
+``disable-next-line=`` on the preceding line); grandfathered findings live
+in the committed ``.mdi-lint-baseline.json``.  See docs/analysis.md.
+"""
+
+from mdi_llm_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    Rule,
+    RULES,
+    lint_paths,
+    lint_source,
+)
+import mdi_llm_tpu.analysis.rules  # noqa: E402,F401  (populates RULES)
+
+__all__ = ["Baseline", "Finding", "Rule", "RULES", "lint_paths", "lint_source"]

@@ -1,0 +1,5 @@
+"""`python -m mdi_llm_tpu.analysis` == `mdi-lint`."""
+
+from mdi_llm_tpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,443 @@
+"""mdi-lint core: findings, the rule registry, suppressions, the baseline.
+
+A rule is a function ``check(module: ModuleInfo) -> Iterable[Finding]``
+registered via the :func:`rule` decorator (implementations live in
+``rules.py``).  ``ModuleInfo`` does the shared one-pass AST analysis every
+rule needs: parent links, the set of jit-compiled function bodies with
+their static/donated argument specs, and module-level state.
+
+Suppressions are per line::
+
+    toks = jax.device_get(emits)  # mdi-lint: disable=host-sync -- one batched fetch
+
+    # mdi-lint: disable-next-line=tracer-branch -- shape check, not a value branch
+    if x.ndim == 2: ...
+
+Everything after ``--`` is a free-form justification.  ``disable=all``
+silences every rule on that line.
+
+The baseline (``.mdi-lint-baseline.json``) grandfathers existing findings:
+keys are ``rule::path::<stripped source line>`` with an occurrence count,
+so findings survive line-number drift but a NEW violation of the same rule
+on a different line still fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+BASELINE_NAME = ".mdi-lint-baseline.json"
+
+# ---------------------------------------------------------------------------
+# findings + registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix, relative to the lint root when possible
+    line: int
+    col: int
+    message: str
+    line_text: str = ""  # stripped source line, used for baseline keys
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.line_text}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    check: Callable[["ModuleInfo"], Iterable[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, summary: str):
+    """Register a rule implementation under `name` (kebab-case)."""
+
+    def deco(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        RULES[name] = Rule(name, summary, fn)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# shared AST analysis
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JitSpec:
+    """Static/donated argument info parsed from a jit decoration site."""
+
+    static_argnames: Set[str] = dataclasses.field(default_factory=set)
+    static_argnums: Set[int] = dataclasses.field(default_factory=set)
+    donate_argnums: Set[int] = dataclasses.field(default_factory=set)
+    donate_argnames: Set[str] = dataclasses.field(default_factory=set)
+    call: Optional[ast.Call] = None  # the jit/partial call node, if any
+
+
+@dataclasses.dataclass
+class JittedFn:
+    node: ast.FunctionDef
+    spec: JitSpec
+
+    @property
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def static_params(self) -> Set[str]:
+        names = set(self.spec.static_argnames)
+        params = self.param_names
+        for i in self.spec.static_argnums:
+            if 0 <= i < len(params):
+                names.add(params[i])
+        return names
+
+    def donated_params(self) -> Set[str]:
+        names = set(self.spec.donate_argnames)
+        params = self.param_names
+        for i in self.spec.donate_argnums:
+            if 0 <= i < len(params):
+                names.add(params[i])
+        return names
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d in ("jit", "pjit") or d.endswith(".jit") or d.endswith(".pjit")
+
+
+def _int_elems(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    elems = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elems:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            out.add(e.value)
+    return out
+
+
+def _str_elems(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    elems = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elems:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.add(e.value)
+    return out
+
+
+def _spec_from_kwargs(call: ast.Call) -> JitSpec:
+    spec = JitSpec(call=call)
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            spec.static_argnames |= _str_elems(kw.value)
+        elif kw.arg == "static_argnums":
+            spec.static_argnums |= _int_elems(kw.value)
+        elif kw.arg == "donate_argnums":
+            spec.donate_argnums |= _int_elems(kw.value)
+        elif kw.arg == "donate_argnames":
+            spec.donate_argnames |= _str_elems(kw.value)
+    return spec
+
+
+def jit_spec_of_call(call: ast.Call) -> Optional[JitSpec]:
+    """JitSpec if `call` is a jit decoration/wrapping site, else None.
+
+    Recognizes ``jax.jit(...)``, ``jit(...)``, ``pjit(...)`` and
+    ``[functools.]partial(jax.jit, ...)``.
+    """
+    if _is_jit_ref(call.func):
+        return _spec_from_kwargs(call)
+    d = _dotted(call.func)
+    if (d == "partial" or d.endswith(".partial")) and call.args:
+        if _is_jit_ref(call.args[0]):
+            return _spec_from_kwargs(call)
+    return None
+
+
+def jit_spec_of_decorator(dec: ast.AST) -> Optional[JitSpec]:
+    if _is_jit_ref(dec):
+        return JitSpec()
+    if isinstance(dec, ast.Call):
+        return jit_spec_of_call(dec)
+    return None
+
+
+class ModuleInfo:
+    """One parsed module plus the pre-computed facts rules share."""
+
+    def __init__(self, path: str, source: str, tree: Optional[ast.Module] = None):
+        self.path = path
+        self.source = source
+        self.tree = tree if tree is not None else ast.parse(source)
+        self.lines = source.splitlines()
+        # child -> parent links (rules walk up for enclosing context)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # jit-compiled function bodies: decorated defs, plus defs wrapped at
+        # an assignment site (g = jax.jit(f, ...)) resolved within the module
+        self.jitted: List[JittedFn] = []
+        wrapped: Dict[str, JitSpec] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    spec = jit_spec_of_decorator(dec)
+                    if spec is not None:
+                        self.jitted.append(JittedFn(node, spec))
+                        break
+            elif isinstance(node, ast.Call):
+                spec = jit_spec_of_call(node)
+                # jax.jit(f, ...) wrapping a named local function
+                if (
+                    spec is not None
+                    and node.args
+                    and not _is_jit_ref(node.args[0])
+                    and isinstance(node.args[0], ast.Name)
+                ):
+                    wrapped[node.args[0].id] = spec
+        if wrapped:
+            jitted_nodes = {j.node for j in self.jitted}
+            for node in ast.walk(self.tree):
+                if (
+                    isinstance(node, ast.FunctionDef)
+                    and node.name in wrapped
+                    and node not in jitted_nodes
+                ):
+                    self.jitted.append(JittedFn(node, wrapped[node.name]))
+        self._jit_bodies: Optional[Set[ast.AST]] = None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule_name: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule_name,
+            path=self.path,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            line_text=self.line_text(line),
+        )
+
+    # -- enclosing-context helpers ------------------------------------------
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+    def jit_body_nodes(self) -> Set[ast.AST]:
+        """Every AST node lexically inside a jit-compiled function body."""
+        if self._jit_bodies is None:
+            self._jit_bodies = set()
+            for j in self.jitted:
+                for n in ast.walk(j.node):
+                    self._jit_bodies.add(n)
+        return self._jit_bodies
+
+    def in_jit(self, node: ast.AST) -> bool:
+        return node in self.jit_body_nodes()
+
+    def enclosing_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.For, ast.While, ast.AsyncFor)):
+                return a
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None  # don't escape into an enclosing function's loop
+        return None
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mdi-lint:\s*disable(?P<next>-next-line)?\s*=\s*"
+    r"(?P<rules>all|[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+
+def suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> rule names suppressed there ('all' wins)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        names = {r.strip() for r in m.group("rules").split(",")}
+        target = i + 1 if m.group("next") else i
+        out.setdefault(target, set()).update(names)
+    return out
+
+
+def _is_suppressed(f: Finding, sup: Dict[int, Set[str]]) -> bool:
+    names = sup.get(f.line, ())
+    return "all" in names or f.rule in names
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+class Baseline:
+    """Grandfathered findings, keyed by rule + path + source-line text."""
+
+    def __init__(self, counts: Optional[Dict[str, int]] = None):
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not Path(path).exists():
+            return cls()
+        data = json.loads(Path(path).read_text())
+        return cls(data.get("findings", {}))
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding]) -> "Baseline":
+        b = cls()
+        for f in findings:
+            b.counts[f.baseline_key] = b.counts.get(f.baseline_key, 0) + 1
+        return b
+
+    def save(self, path: Path) -> None:
+        data = {
+            "note": (
+                "mdi-lint grandfathered findings; regenerate with "
+                "`mdi-lint <paths> --update-baseline`.  Fix findings rather "
+                "than baselining them whenever possible."
+            ),
+            "version": 1,
+            "findings": {k: self.counts[k] for k in sorted(self.counts)},
+        }
+        Path(path).write_text(json.dumps(data, indent=2) + "\n")
+
+    def split(
+        self, findings: Sequence[Finding]
+    ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, grandfathered); at most `count` findings per key pass."""
+        remaining = dict(self.counts)
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+            if remaining.get(f.baseline_key, 0) > 0:
+                remaining[f.baseline_key] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+
+# ---------------------------------------------------------------------------
+# driving
+# ---------------------------------------------------------------------------
+
+
+def _selected_rules(select: Optional[Sequence[str]]) -> List[Rule]:
+    import mdi_llm_tpu.analysis.rules  # noqa: F401  (registers RULES)
+
+    if not select:
+        return list(RULES.values())
+    missing = [s for s in select if s not in RULES]
+    if missing:
+        raise KeyError(f"unknown rule(s): {', '.join(missing)}")
+    return [RULES[s] for s in select]
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    select: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one source string; returns unsuppressed findings."""
+    mod = ModuleInfo(path, source)
+    sup = suppressions(source)
+    findings: List[Finding] = []
+    for r in _selected_rules(select):
+        findings.extend(r.check(mod))
+    findings = [f for f in findings if not _is_suppressed(f, sup)]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def iter_py_files(paths: Sequence[Path]) -> Iterator[Tuple[Path, Optional[str]]]:
+    """Yield (py_file, None) for found files, (path, error) for bad inputs."""
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                # skip hidden dirs BELOW the lint root only (the root itself
+                # may live under e.g. ~/.cache without hiding every file)
+                if not any(part.startswith(".") for part in f.relative_to(p).parts):
+                    yield f, None
+        elif p.suffix == ".py" and p.exists():
+            yield p, None
+        else:
+            yield p, (
+                "no such file or directory" if not p.exists()
+                else "not a .py file or directory"
+            )
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    root: Optional[Path] = None,
+    select: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], List[str]]:
+    """Lint files/directories.  Returns (findings, errors).
+
+    Paths in findings are relative to `root` (default: cwd) so baseline
+    keys are stable regardless of how the tool was invoked.
+    """
+    root = Path(root) if root is not None else Path.cwd()
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for f, err in iter_py_files(paths):
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        if err is not None:
+            errors.append(f"{rel}: {err}")
+            continue
+        try:
+            source = f.read_text()
+            findings.extend(lint_source(source, path=rel, select=select))
+        except SyntaxError as e:
+            errors.append(f"{rel}: syntax error: {e}")
+        except OSError as e:
+            errors.append(f"{rel}: {e}")
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)), errors

@@ -1,0 +1,498 @@
+"""mdi-lint rule implementations.
+
+Every rule encodes one way a JAX/TPU hot path silently degrades: a hidden
+host sync, a Python branch on a tracer, a donated buffer read after the
+call, a jit cache keyed on float values.  `docs/analysis.md` documents each
+rule with a bad/good snippet pair; `tests/test_lint.py` pins every rule
+with a triggering and a passing fixture.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from mdi_llm_tpu.analysis.core import (
+    Finding,
+    JittedFn,
+    ModuleInfo,
+    _dotted,
+    jit_spec_of_call,
+    jit_spec_of_decorator,
+    rule,
+)
+
+# numpy module aliases as conventionally imported in this repo
+_NP_NAMES = {"np", "numpy"}
+# methods whose mere invocation forces a device->host transfer / sync
+_SYNC_METHODS = {"item", "block_until_ready"}
+# jax functions that force a device->host transfer / sync
+_SYNC_FUNCS = {"jax.device_get", "device_get", "jax.block_until_ready"}
+
+
+def _is_host_sync_call(call: ast.Call) -> Optional[str]:
+    """Describe the host sync a Call performs, or None."""
+    d = _dotted(call.func)
+    if d in _SYNC_FUNCS:
+        return f"`{d}` forces a device->host transfer"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _SYNC_METHODS:
+        return f"`.{call.func.attr}()` blocks on the device"
+    return None
+
+
+def _is_np_materialize(call: ast.Call) -> Optional[str]:
+    d = _dotted(call.func)
+    if "." in d:
+        root, attr = d.split(".", 1)
+        if root in _NP_NAMES and attr in ("asarray", "array", "copy"):
+            return f"`{d}` materializes the operand on the host"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# host syncs
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "host-sync-in-jit",
+    "host transfer/sync (.item, device_get, np.asarray, ...) inside a jitted function",
+)
+def host_sync_in_jit(mod: ModuleInfo) -> Iterator[Finding]:
+    for j in mod.jitted:
+        for node in ast.walk(j.node):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _is_host_sync_call(node) or _is_np_materialize(node)
+            if why:
+                yield mod.finding(
+                    "host-sync-in-jit",
+                    node,
+                    f"{why} inside jitted `{j.node.name}`; on a tracer this "
+                    "either fails or silently falls back to per-call host "
+                    "round-trips — keep the body device-only",
+                )
+
+
+@rule(
+    "host-sync",
+    "device_get/.item()/block_until_ready on a hot path (worst inside a step loop)",
+)
+def host_sync(mod: ModuleInfo) -> Iterator[Finding]:
+    jit_nodes = mod.jit_body_nodes()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or node in jit_nodes:
+            continue
+        why = _is_host_sync_call(node)
+        if not why:
+            continue
+        loop = mod.enclosing_loop(node)
+        where = (
+            "inside a per-step loop — each iteration stalls the device "
+            "pipeline for a full host round-trip"
+            if loop is not None
+            else "on the host path"
+        )
+        yield mod.finding(
+            "host-sync",
+            node,
+            f"{why} {where}; hoist/batch it, or suppress with a "
+            "justification if the sync is the point",
+        )
+
+
+# ---------------------------------------------------------------------------
+# tracer-branch
+# ---------------------------------------------------------------------------
+
+
+def _safe_name_use(mod: ModuleInfo, name_node: ast.Name) -> bool:
+    """A use of a traced param inside a branch test that is NOT a trace-time
+    value branch: attribute access (x.shape/x.ndim/x.dtype are concrete),
+    `x is [not] None`, and isinstance(x, ...) are all static structure."""
+    parent = mod.parents.get(name_node)
+    if isinstance(parent, ast.Attribute):
+        return True
+    if isinstance(parent, ast.Compare):
+        ops_ok = all(isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops)
+        if ops_ok:
+            return True
+    if isinstance(parent, ast.Call):
+        d = _dotted(parent.func)
+        if d in ("isinstance", "len", "type", "hasattr", "getattr"):
+            return True
+    return False
+
+
+@rule(
+    "tracer-branch",
+    "Python if/while on a traced jit argument (works only via retrace, or raises)",
+)
+def tracer_branch(mod: ModuleInfo) -> Iterator[Finding]:
+    for j in mod.jitted:
+        static = j.static_params()
+        traced = set(j.param_names) - static
+        if not traced:
+            continue
+        for node in ast.walk(j.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for sub in ast.walk(node.test):
+                if (
+                    isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in traced
+                    and not _safe_name_use(mod, sub)
+                ):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield mod.finding(
+                        "tracer-branch",
+                        node,
+                        f"Python `{kind}` on traced argument `{sub.id}` of "
+                        f"jitted `{j.node.name}`: a tracer has no bool — this "
+                        "raises at trace time (or recompiles per value if the "
+                        "arg is made static); use lax.cond/jnp.where, or add "
+                        f"`{sub.id}` to static_argnames only if its value set "
+                        "is tiny and hashable",
+                    )
+                    break  # one finding per branch statement
+
+
+# ---------------------------------------------------------------------------
+# donation-after-use
+# ---------------------------------------------------------------------------
+
+
+def _stmt_chain(mod: ModuleInfo, node: ast.AST) -> Optional[ast.stmt]:
+    """The statement directly containing `node`."""
+    cur = node
+    while cur in mod.parents:
+        parent = mod.parents[cur]
+        if hasattr(parent, "body") and isinstance(cur, ast.stmt):
+            return cur
+        cur = parent
+    return None
+
+
+def _names_loaded(node: ast.AST) -> Set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _names_stored(node: ast.AST) -> Set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }
+
+
+@rule(
+    "donation-after-use",
+    "buffer passed at a donate_argnums position is read after the jitted call",
+)
+def donation_after_use(mod: ModuleInfo) -> Iterator[Finding]:
+    # jitted callables resolvable by name within this module
+    donors: dict = {}
+    for j in mod.jitted:
+        if j.spec.donate_argnums or j.spec.donate_argnames:
+            donors[j.node.name] = j
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            spec = jit_spec_of_call(node.value)
+            if spec is None or not (spec.donate_argnums or spec.donate_argnames):
+                continue
+            # name = jax.jit(f, donate_argnums=...) — alias carries the spec
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and node.value.args:
+                    fn = node.value.args[0]
+                    if isinstance(fn, ast.Name) and fn.id in donors:
+                        donors[tgt.id] = donors[fn.id]
+
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+            continue
+        j = donors.get(node.func.id)
+        if j is None:
+            continue
+        donated = j.donated_params()
+        params = j.param_names
+        donated_args: List[str] = []
+        for i, arg in enumerate(node.args):
+            if i < len(params) and params[i] in donated and isinstance(arg, ast.Name):
+                donated_args.append(arg.id)
+        for kw in node.keywords:
+            if kw.arg in donated and isinstance(kw.value, ast.Name):
+                donated_args.append(kw.value.id)
+        if not donated_args:
+            continue
+        stmt = _stmt_chain(mod, node)
+        if stmt is None:
+            continue
+        parent = mod.parents.get(stmt)
+        body = getattr(parent, "body", None)
+        if not isinstance(body, list) or stmt not in body:
+            continue
+        # donated name re-bound by the call's own statement (x = f(x)) is safe
+        rebound = _names_stored(stmt)
+        live = [n for n in donated_args if n not in rebound]
+        for later in body[body.index(stmt) + 1 :]:
+            if not live:
+                break
+            loaded = _names_loaded(later)
+            for name in list(live):
+                if name in loaded:
+                    yield mod.finding(
+                        "donation-after-use",
+                        later,
+                        f"`{name}` was donated to jitted `{j.node.name}` "
+                        f"(line {stmt.lineno}) and is read afterwards: the "
+                        "buffer is deleted by donation — rebind the result "
+                        "or drop the donation",
+                    )
+                    live.remove(name)
+            live = [n for n in live if n not in _names_stored(later)]
+
+
+# ---------------------------------------------------------------------------
+# recompile hazards
+# ---------------------------------------------------------------------------
+
+# names that in this codebase always carry float sampling/scaling knobs; a
+# float static arg keys the jit cache on the VALUE (0.7 vs 0.8 = 2 compiles)
+_FLOATY_NAMES = {
+    "temperature", "top_p", "scale", "eps", "rate", "ratio",
+    "threshold", "prob", "penalty", "alpha", "dropout",
+}
+
+
+def _param_is_floaty(fn: ast.FunctionDef, name: str) -> Optional[str]:
+    a = fn.args
+    params = a.posonlyargs + a.args + a.kwonlyargs
+    defaults = list(a.defaults)
+    # align defaults with the tail of posonly+args
+    pos = a.posonlyargs + a.args
+    default_of = {}
+    for p, d in zip(pos[len(pos) - len(defaults) :], defaults):
+        default_of[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            default_of[p.arg] = d
+    for p in params:
+        if p.arg != name:
+            continue
+        ann = getattr(p, "annotation", None)
+        if ann is not None and _dotted(ann) == "float":
+            return "annotated `float`"
+        d = default_of.get(name)
+        if isinstance(d, ast.Constant) and isinstance(d.value, float):
+            return f"float default {d.value!r}"
+        if name in _FLOATY_NAMES:
+            return "a float-valued knob by convention"
+    return None
+
+
+@rule(
+    "static-float-arg",
+    "static_argnames/nums entry that carries a float (one XLA compile per distinct value)",
+)
+def static_float_arg(mod: ModuleInfo) -> Iterator[Finding]:
+    for j in mod.jitted:
+        params = j.param_names
+        statics = set(j.spec.static_argnames)
+        for i in j.spec.static_argnums:
+            if 0 <= i < len(params):
+                statics.add(params[i])
+        for name in sorted(statics):
+            why = _param_is_floaty(j.node, name)
+            if why:
+                anchor = j.spec.call if j.spec.call is not None else j.node
+                yield mod.finding(
+                    "static-float-arg",
+                    anchor,
+                    f"static arg `{name}` of jitted `{j.node.name}` is {why}: "
+                    "the jit cache keys on its value, so every distinct "
+                    "float triggers a full recompile — pass it as a traced "
+                    "operand (see ops/sampling.py sample_traced)",
+                )
+
+
+@rule(
+    "jit-in-loop",
+    "jax.jit called inside a loop body (fresh cache per iteration = recompile every time)",
+)
+def jit_in_loop(mod: ModuleInfo) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        spec = None
+        anchor = node
+        if isinstance(node, ast.Call):
+            spec = jit_spec_of_call(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                spec = jit_spec_of_decorator(dec)
+                if spec is not None:
+                    break
+        if spec is None:
+            continue
+        if mod.enclosing_loop(anchor) is not None:
+            yield mod.finding(
+                "jit-in-loop",
+                anchor,
+                "jit created inside a loop body: each iteration builds a "
+                "fresh wrapper with an empty cache, so every call recompiles "
+                "— hoist the jit out of the loop (cache it on the instance "
+                "like generation.py's `_decode_fns`)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# dtype hygiene
+# ---------------------------------------------------------------------------
+
+_LAX_BINOPS = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "rem", "atan2",
+    "eq", "ne", "lt", "le", "gt", "ge",
+}
+
+
+@rule(
+    "lax-scalar-operand",
+    "bare Python number passed to a strict jax.lax binary op (dtype promotion trap)",
+)
+def lax_scalar_operand(mod: ModuleInfo) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        parts = d.split(".")
+        if len(parts) < 2 or parts[-2] != "lax" or parts[-1] not in _LAX_BINOPS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+                yield mod.finding(
+                    "lax-scalar-operand",
+                    arg,
+                    f"bare Python scalar {arg.value!r} passed to `{d}`: lax "
+                    "ops are strict about dtypes — a weak f64/f32 scalar "
+                    "either errors or silently upcasts a bf16 model value; "
+                    "wrap it with jnp.asarray(x, operand.dtype)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# closures over module state
+# ---------------------------------------------------------------------------
+
+_MUTABLE_CTORS = {"list", "dict", "set", "defaultdict", "deque", "Counter", "OrderedDict"}
+
+
+def _module_mutable_globals(mod: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in mod.tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set)) or (
+            isinstance(value, ast.Call)
+            and _dotted(value.func).split(".")[-1] in _MUTABLE_CTORS
+        )
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+@rule(
+    "mutable-global-in-jit",
+    "module-level mutable state captured by a jitted function (baked in at trace time)",
+)
+def mutable_global_in_jit(mod: ModuleInfo) -> Iterator[Finding]:
+    mutables = _module_mutable_globals(mod)
+    if not mutables:
+        return
+    for j in mod.jitted:
+        locals_: Set[str] = set(j.param_names)
+        for n in ast.walk(j.node):
+            locals_ |= _names_stored(n)
+        seen: Set[str] = set()  # one finding per (fn, global) is plenty
+        for n in ast.walk(j.node):
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in mutables
+                and n.id not in locals_
+                and n.id not in seen
+            ):
+                seen.add(n.id)
+                yield mod.finding(
+                    "mutable-global-in-jit",
+                    n,
+                    f"jitted `{j.node.name}` closes over module-level mutable "
+                    f"`{n.id}`: its contents are baked in at trace time — "
+                    "later mutations are silently ignored by the compiled "
+                    "program; pass it as an argument instead",
+                )
+
+
+# ---------------------------------------------------------------------------
+# profiler hygiene for public kernels
+# ---------------------------------------------------------------------------
+
+# a public ops/ function whose body performs at least this many jax-namespace
+# calls is a "kernel" and must open a named_scope so device traces (and
+# CompileGuard investigations) attribute its cost
+_NAMED_SCOPE_MIN_OPS = 8
+_JAX_ROOTS = {"jnp", "jax", "lax", "pl", "pltpu", "plgpu"}
+
+
+def _jax_op_calls(fn: ast.FunctionDef) -> int:
+    n = 0
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d.split(".")[0] in _JAX_ROOTS:
+                n += 1
+    return n
+
+
+def _has_named_scope(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d.endswith("named_scope") or d.endswith("annotate_function") or (
+                "profiler" in d and d.endswith("TraceAnnotation")
+            ):
+                return True
+    return False
+
+
+@rule(
+    "missing-named-scope",
+    "public ops/ kernel without a jax.named_scope (invisible in device traces)",
+)
+def missing_named_scope(mod: ModuleInfo) -> Iterator[Finding]:
+    if "ops/" not in mod.path.replace("\\", "/"):
+        return
+    for stmt in mod.tree.body:
+        if not isinstance(stmt, ast.FunctionDef) or stmt.name.startswith("_"):
+            continue
+        if _jax_op_calls(stmt) < _NAMED_SCOPE_MIN_OPS:
+            continue
+        if not _has_named_scope(stmt):
+            yield mod.finding(
+                "missing-named-scope",
+                stmt,
+                f"public kernel `{stmt.name}` never opens a jax.named_scope: "
+                "its ops are anonymous in TensorBoard/Perfetto device traces "
+                "— wrap the body in `with jax.named_scope(...)`",
+            )

@@ -39,7 +39,12 @@ from mdi_llm_tpu.config import TEMPERATURE, TOP_K, Config
 from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.utils.context_managers import catch_loop_errors
 from mdi_llm_tpu.ops.quant import FLAG_TO_MODE
-from mdi_llm_tpu.ops.sampling import sample
+from mdi_llm_tpu.ops.sampling import (
+    sample,
+    sample_mode,
+    sample_traced,
+    sampling_operands,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -445,15 +450,19 @@ class Generator:
     def _decode_fn(self, B: int):
         if B not in self._decode_fns:
 
-            @partial(jax.jit, donate_argnums=(2,), static_argnames=("temperature", "top_k", "top_p"))
-            def decode(params, tokens, kv, input_pos, key, temperature, top_k, top_p):
+            # temperature/top_p are traced f32 operands — only the tiny
+            # `mode` string and the int top_k key the jit cache, so sweeping
+            # temperature never recompiles (mdi-lint: static-float-arg)
+            @partial(jax.jit, donate_argnums=(2,), static_argnames=("mode", "top_k"))
+            def decode(params, tokens, kv, input_pos, key, temperature, top_p,
+                       mode, top_k):
                 logits, kv = transformer.forward(
                     self.cfg, params, tokens, input_pos, kv=kv, rope=self.rope,
                     moe_impl=self._moe_impl, unroll=self.scan_unroll,
                 )
                 key, sub = jax.random.split(key)
-                tok = sample(
-                    logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p
+                tok = sample_traced(
+                    logits[:, -1], sub, temperature, top_p, mode=mode, top_k=top_k
                 )
                 return tok.astype(jnp.int32), kv, key
 
@@ -467,12 +476,15 @@ class Generator:
         key_ = (B, n_steps)
         if key_ not in self._decode_chunk_fns:
 
+            # see _decode_fn: float knobs are traced so the cache keys only
+            # on (mode, top_k), never on a float value
             @partial(
                 jax.jit,
                 donate_argnums=(2,),
-                static_argnames=("temperature", "top_k", "top_p"),
+                static_argnames=("mode", "top_k"),
             )
-            def decode_chunk(params, tok0, kv, input_pos, key, temperature, top_k, top_p):
+            def decode_chunk(params, tok0, kv, input_pos, key, temperature,
+                             top_p, mode, top_k):
                 def body(carry, _):
                     tok, kv, pos, key = carry
                     logits, kv = transformer.forward(
@@ -480,9 +492,9 @@ class Generator:
                         moe_impl=self._moe_impl, unroll=self.scan_unroll,
                     )
                     key, sub = jax.random.split(key)
-                    nxt = sample(
-                        logits[:, -1], sub,
-                        temperature=temperature, top_k=top_k, top_p=top_p,
+                    nxt = sample_traced(
+                        logits[:, -1], sub, temperature, top_p,
+                        mode=mode, top_k=top_k,
                     ).astype(jnp.int32)
                     return (nxt, kv, pos + 1, key), nxt
 
@@ -663,6 +675,9 @@ class Generator:
         # verify in one forward, emit the matching prefix + bonus token ----
         if speculative:
             K = int(speculative)
+            # loop-invariant device operands hoisted: two tiny host->device
+            # uploads per token would be pure RTT tax on a remote chip
+            t_greedy, p_greedy = sampling_operands(0.0, top_p)
             with catch_loop_errors() as g_spec:
                 while (
                     n < max_new_tokens
@@ -685,9 +700,10 @@ class Generator:
                             kv,
                             jnp.asarray(positions),
                             self.key,
-                            temperature=0.0,
+                            t_greedy,
+                            p_greedy,
+                            mode="greedy",
                             top_k=top_k,
-                            top_p=top_p,
                         )
                         toks_np = np.asarray(toks_j)
                         fed = 0
@@ -754,6 +770,9 @@ class Generator:
             lanes = [lanes[b] for b in active] + [None] * (nB - len(active))
             stats.compactions += 1
 
+        # loop-invariant sampling operands/mode hoisted out of the chunk loop
+        t_op, p_op = sampling_operands(temperature, top_p)
+        mode = sample_mode(temperature, top_k, top_p)
         # Ctrl-C mid-loop returns what was generated so far
         # (≡ catch_loop_errors clean shutdown, context_managers.py:16-57)
         with catch_loop_errors() as guard:
@@ -770,9 +789,10 @@ class Generator:
                     kv,
                     jnp.asarray(positions),
                     self.key,
-                    temperature=temperature,
+                    t_op,
+                    p_op,
+                    mode=mode,
                     top_k=top_k,
-                    top_p=top_p,
                 )
                 toks_np = np.asarray(toks_j)  # (k, len(lanes))
                 for i in range(k):
@@ -967,6 +987,9 @@ def _decode_token_stream(
     decode = gen._decode_fn(1)
     tok = first_tok
     pos = np.asarray([start_pos], np.int32)
+    # loop-invariant sampling operands: uploaded once, not per token
+    t_op, p_op = sampling_operands(temperature, top_p)
+    mode = sample_mode(temperature, top_k, top_p)
     emitted: List[int] = []
     for i in range(max_new):
         t = int(tok[0])
@@ -979,7 +1002,7 @@ def _decode_token_stream(
         kv_in, kvbox[0] = kvbox[0], None  # donated
         tok_j, kv_out, gen.key = decode(
             gen.params, jnp.asarray(tok)[:, None], kv_in, jnp.asarray(pos),
-            gen.key, temperature=temperature, top_k=top_k, top_p=top_p,
+            gen.key, t_op, p_op, mode=mode, top_k=top_k,
         )
         kvbox[0] = kv_out
         tok = np.asarray(tok_j)
@@ -1109,6 +1132,7 @@ class ChatSession:
         gen = self.gen
         tok = tok0
         pos = prompt_end  # absolute slot of the current unfed token
+        t_greedy, p_greedy = sampling_operands(0.0, top_p)  # loop-invariant
         emitted: List[int] = [int(tok[0])]
         posbox[0] = pos
         yield emitted[0]
@@ -1152,7 +1176,7 @@ class ChatSession:
                 tok_j, kv_out, gen.key = gen._decode_fn(1)(
                     gen.params, jnp.asarray(tok)[:, None], kv_in,
                     jnp.asarray([pos], jnp.int32), gen.key,
-                    temperature=0.0, top_k=top_k, top_p=top_p,
+                    t_greedy, p_greedy, mode="greedy", top_k=top_k,
                 )
                 self._kvbox[0] = kv_out
                 tok = np.asarray(tok_j)

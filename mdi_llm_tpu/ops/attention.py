@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
@@ -47,30 +48,31 @@ def multihead_attention(
         k = k.astype(q.dtype)
         v = v.astype(q.dtype)
 
-    q_per_kv = n_head // n_groups
-    # fold the query heads into groups: (B, G, q_per_kv, Tq, hs)
-    qg = q.reshape(B, n_groups, q_per_kv, Tq, hs)
+    with jax.named_scope("multihead_attention"):
+        q_per_kv = n_head // n_groups
+        # fold the query heads into groups: (B, G, q_per_kv, Tq, hs)
+        qg = q.reshape(B, n_groups, q_per_kv, Tq, hs)
 
-    # logits in f32 for numerical stability on bf16 inputs
-    logits = jnp.einsum(
-        "bgqth,bgsh->bgqts", qg, k, preferred_element_type=jnp.float32
-    )
-    logits = logits * scale
+        # logits in f32 for numerical stability on bf16 inputs
+        logits = jnp.einsum(
+            "bgqth,bgsh->bgqts", qg, k, preferred_element_type=jnp.float32
+        )
+        logits = logits * scale
 
-    # causal + validity mask from absolute positions
-    if k_pos is None:
-        k_pos = jnp.broadcast_to(jnp.arange(Tk, dtype=q_pos.dtype), (B, Tk))
-    mask = k_pos[:, None, :] <= q_pos[:, :, None]  # (B, Tq, Tk)
-    if kv_valid_len is not None:
-        slot = jnp.arange(Tk, dtype=q_pos.dtype)
-        mask = mask & (slot[None, None, :] < kv_valid_len[:, None, None])
-    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+        # causal + validity mask from absolute positions
+        if k_pos is None:
+            k_pos = jnp.broadcast_to(jnp.arange(Tk, dtype=q_pos.dtype), (B, Tk))
+        mask = k_pos[:, None, :] <= q_pos[:, :, None]  # (B, Tq, Tk)
+        if kv_valid_len is not None:
+            slot = jnp.arange(Tk, dtype=q_pos.dtype)
+            mask = mask & (slot[None, None, :] < kv_valid_len[:, None, None])
+        logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
 
-    probs = jnp.exp(
-        logits - jnp.max(logits, axis=-1, keepdims=True)
-    )
-    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
-    probs = probs.astype(v.dtype)
+        probs = jnp.exp(
+            logits - jnp.max(logits, axis=-1, keepdims=True)
+        )
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        probs = probs.astype(v.dtype)
 
-    out = jnp.einsum("bgqts,bgsh->bgqth", probs, v)
-    return out.reshape(B, n_head, Tq, hs)
+        out = jnp.einsum("bgqts,bgsh->bgqth", probs, v)
+        return out.reshape(B, n_head, Tq, hs)

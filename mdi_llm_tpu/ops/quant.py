@@ -269,28 +269,29 @@ def quantized_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     """einsum against a (possibly) quantized weight dict.  `spec` contracts
     x with the stored (out, in)-layout weight; the per-out-channel scale is
     applied to the result (exact: it factors out of the contraction)."""
-    if "weight_q8" in p:
-        # dynamic per-token symmetric activation quant + int8×int8 MXU dot
-        amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
-        xs = jnp.maximum(amax / 127.0, 1e-10)
-        xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127, 127).astype(
-            jnp.int8
-        )
-        y = jnp.einsum(spec, xq, p["weight_q8"], preferred_element_type=jnp.int32)
-        # xs covers x's leading (token/batch) dims; pad trailing singleton
-        # axes so it broadcasts over whatever output dims the spec appended
-        # (1 for plain linears, 2 for the expert einsums)
-        extra = y.ndim - (x.ndim - 1)
-        xs = xs.reshape(xs.shape[:-1] + (1,) * max(extra, 1))
-        return _apply_scale(spec, y.astype(jnp.float32) * xs, p["scale"]).astype(
-            x.dtype
-        )
-    if "weight_q" in p:
-        y = jnp.einsum(spec, x, p["weight_q"].astype(x.dtype))
-        return _apply_scale(spec, y, p["scale"].astype(x.dtype))
-    if "weight_q4" in p:
-        return _w4_einsum(spec, x, p)
-    return jnp.einsum(spec, x, p["weight"])
+    with jax.named_scope("quantized_einsum"):
+        if "weight_q8" in p:
+            # dynamic per-token symmetric activation quant + int8×int8 MXU dot
+            amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+            xs = jnp.maximum(amax / 127.0, 1e-10)
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127, 127).astype(
+                jnp.int8
+            )
+            y = jnp.einsum(spec, xq, p["weight_q8"], preferred_element_type=jnp.int32)
+            # xs covers x's leading (token/batch) dims; pad trailing singleton
+            # axes so it broadcasts over whatever output dims the spec appended
+            # (1 for plain linears, 2 for the expert einsums)
+            extra = y.ndim - (x.ndim - 1)
+            xs = xs.reshape(xs.shape[:-1] + (1,) * max(extra, 1))
+            return _apply_scale(spec, y.astype(jnp.float32) * xs, p["scale"]).astype(
+                x.dtype
+            )
+        if "weight_q" in p:
+            y = jnp.einsum(spec, x, p["weight_q"].astype(x.dtype))
+            return _apply_scale(spec, y, p["scale"].astype(x.dtype))
+        if "weight_q4" in p:
+            return _w4_einsum(spec, x, p)
+        return jnp.einsum(spec, x, p["weight"])
 
 
 def _w4_einsum(spec: str, x: jnp.ndarray, p: Params) -> jnp.ndarray:

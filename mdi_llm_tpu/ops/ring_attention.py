@@ -141,21 +141,22 @@ def ring_attention(
         kp_n = jax.lax.ppermute(kp_c, axis_name, perm)
         return (k_n, v_n, kp_n, m_new, l, o), None
 
-    if use_flash and causal:
-        # the diagonal block is already in the carry: start from the
-        # neighbors' chunks and walk the remaining P-1 hops fully fused
-        k1 = jax.lax.ppermute(k, axis_name, perm)
-        v1 = jax.lax.ppermute(v, axis_name, perm)
-        kp1 = jax.lax.ppermute(k_pos, axis_name, perm)
-        (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
-            flash_body, (k1, v1, kp1, m0, l0, o0), None, length=P - 1
-        )
-    else:
-        (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
-            body, (k, v, k_pos, m0, l0, o0), None, length=P
-        )
-    out = o / jnp.maximum(l[..., None], 1e-30)
-    return out.reshape(B, n_head, Tq, hs).astype(q.dtype)
+    with jax.named_scope("ring_attention"):
+        if use_flash and causal:
+            # the diagonal block is already in the carry: start from the
+            # neighbors' chunks and walk the remaining P-1 hops fully fused
+            k1 = jax.lax.ppermute(k, axis_name, perm)
+            v1 = jax.lax.ppermute(v, axis_name, perm)
+            kp1 = jax.lax.ppermute(k_pos, axis_name, perm)
+            (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
+                flash_body, (k1, v1, kp1, m0, l0, o0), None, length=P - 1
+            )
+        else:
+            (k_f, v_f, kp_f, m, l, o), _ = jax.lax.scan(
+                body, (k, v, k_pos, m0, l0, o0), None, length=P
+            )
+        out = o / jnp.maximum(l[..., None], 1e-30)
+        return out.reshape(B, n_head, Tq, hs).astype(q.dtype)
 
 
 def ring_decode(
@@ -182,23 +183,24 @@ def ring_decode(
     q_per_kv = n_head // n_groups
     qg = q.reshape(B, n_groups, q_per_kv, Tq, hs)
 
-    s = jnp.einsum(
-        "bgqth,bgsh->bgqts", qg, k_cache, preferred_element_type=jnp.float32
-    ) * scale
-    valid = k_pos[:, None, :] <= q_pos[:, :, None]  # (B, 1, C); empty slots
-    # carry the sentinel position and are never <= a real q_pos
-    s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
+    with jax.named_scope("ring_decode"):
+        s = jnp.einsum(
+            "bgqth,bgsh->bgqts", qg, k_cache, preferred_element_type=jnp.float32
+        ) * scale
+        valid = k_pos[:, None, :] <= q_pos[:, :, None]  # (B, 1, C); empty
+        # slots carry the sentinel position and are never <= a real q_pos
+        s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
 
-    m = jnp.max(s, axis=-1)  # (B, g, q, 1) local max
-    p = jnp.exp(jnp.maximum(s - m[..., None], -80.0))
-    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-    l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bgqts,bgsh->bgqth", p, v_cache.astype(jnp.float32))
+        m = jnp.max(s, axis=-1)  # (B, g, q, 1) local max
+        p = jnp.exp(jnp.maximum(s - m[..., None], -80.0))
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        l = jnp.sum(p, axis=-1)
+        o = jnp.einsum("bgqts,bgsh->bgqth", p, v_cache.astype(jnp.float32))
 
-    # cross-device softmax merge
-    m_g = jax.lax.pmax(m, axis_name)
-    corr = jnp.exp(jnp.maximum(m - m_g, -80.0))
-    l_g = jax.lax.psum(l * corr, axis_name)
-    o_g = jax.lax.psum(o * corr[..., None], axis_name)
-    out = o_g / jnp.maximum(l_g[..., None], 1e-30)
-    return out.reshape(B, n_head, Tq, hs).astype(q.dtype)
+        # cross-device softmax merge
+        m_g = jax.lax.pmax(m, axis_name)
+        corr = jnp.exp(jnp.maximum(m - m_g, -80.0))
+        l_g = jax.lax.psum(l * corr, axis_name)
+        o_g = jax.lax.psum(o * corr[..., None], axis_name)
+        out = o_g / jnp.maximum(l_g[..., None], 1e-30)
+        return out.reshape(B, n_head, Tq, hs).astype(q.dtype)

@@ -5,11 +5,22 @@ Capability parity with the reference sampling helpers
 on `jax.random` so the whole decode step stays on-device.  Greedy decoding
 (temperature == 0) is exact argmax — the parity mode used by the
 golden-token tests (SURVEY.md §7 "output parity").
+
+Two surfaces:
+
+- `sample` — host-side convenience: dispatches on Python float values
+  (greedy / top-p / top-k).  Fine eagerly; as a STATIC jit argument those
+  floats key the compile cache on their value (mdi-lint: static-float-arg).
+- `sample_traced` + `sample_mode` + `sampling_operands` — the jit-friendly
+  split: the branch structure is a tiny static string (`mode`) while
+  temperature/top_p ride along as traced f32 scalars, so sweeping
+  temperature 0.7 -> 0.8 reuses the same XLA executable.  Token streams
+  are identical to `sample` for the matching mode.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,17 +43,10 @@ def logits_to_probs(
     return jax.nn.softmax(logits, axis=-1)
 
 
-def sample_top_p(
-    logits: jnp.ndarray, key: jax.Array, top_p: float, temperature: float = 1.0
-) -> jnp.ndarray:
-    """Nucleus sampling (reference `sample_top_p`, model.py:42-58).
-
-    Keeps the smallest set of tokens whose cumulative probability exceeds
-    `top_p` (always including the most probable token), renormalizes, samples.
-    """
-    logits = logits.astype(jnp.float32)
-    if temperature > 0:
-        logits = logits / temperature
+def _nucleus_filter(logits: jnp.ndarray, top_p) -> jnp.ndarray:
+    """Mask logits outside the smallest set whose cumulative probability
+    exceeds `top_p` (always keeping the most probable token).  `top_p` may
+    be a Python float or a traced f32 scalar — the math is identical."""
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(sorted_probs, axis=-1)
@@ -53,8 +57,23 @@ def sample_top_p(
     cutoff = jnp.min(
         jnp.where(exceeded, jnp.inf, sorted_logits), axis=-1, keepdims=True
     )
-    filtered = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(key, filtered, axis=-1)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def _topk_filter(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def sample_top_p(
+    logits: jnp.ndarray, key: jax.Array, top_p: float, temperature: float = 1.0
+) -> jnp.ndarray:
+    """Nucleus sampling (reference `sample_top_p`, model.py:42-58)."""
+    with jax.named_scope("sample_top_p"):
+        logits = logits.astype(jnp.float32)
+        if temperature > 0:
+            logits = logits / temperature
+        return jax.random.categorical(key, _nucleus_filter(logits, top_p), axis=-1)
 
 
 def sample(
@@ -76,6 +95,60 @@ def sample(
         return sample_top_p(logits, key, top_p, temperature)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        logits = _topk_filter(logits, top_k)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# jit-friendly split: static mode string, traced float knobs
+# ---------------------------------------------------------------------------
+
+
+def sample_mode(
+    temperature: float, top_k: Optional[int] = None, top_p: Optional[float] = None
+) -> str:
+    """The STATIC dispatch key for `sample_traced`, derived host-side from
+    the Python-valued knobs with exactly `sample`'s branch order.  Only this
+    tiny hashable string (and the int `top_k`) belongs in static_argnames —
+    never the floats themselves."""
+    if temperature == 0.0:
+        return "greedy"
+    if top_p is not None and 0.0 < top_p < 1.0:
+        return "top_p"
+    return "top_k"
+
+
+def sampling_operands(
+    temperature: float, top_p: Optional[float]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Device operands for `sample_traced`'s traced knobs.  Unused knobs get
+    harmless placeholders (1.0) so greedy/top-k calls share one signature;
+    XLA dead-code-eliminates them from modes that ignore them."""
+    t = temperature if temperature and temperature > 0 else 1.0
+    p = top_p if top_p is not None else 1.0
+    return jnp.asarray(t, jnp.float32), jnp.asarray(p, jnp.float32)
+
+
+def sample_traced(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    mode: str,
+    top_k: Optional[int] = None,
+) -> jnp.ndarray:
+    """`sample` for jitted decode steps: `temperature`/`top_p` are traced
+    f32 scalars (from `sampling_operands`), so distinct float values reuse
+    one executable; only `mode` (from `sample_mode`) and the int `top_k`
+    shape the graph.  Token streams match `sample` bit-for-bit for the
+    corresponding knob values."""
+    with jax.named_scope(f"sample_{mode}"):
+        if mode == "greedy":
+            return jnp.argmax(logits, axis=-1)
+        logits = logits.astype(jnp.float32) / temperature
+        if mode == "top_p":
+            logits = _nucleus_filter(logits, top_p)
+        elif top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+            logits = _topk_filter(logits, top_k)
+        return jax.random.categorical(key, logits, axis=-1)

@@ -42,7 +42,12 @@ from mdi_llm_tpu.generation import (
     find_eot,
 )
 from mdi_llm_tpu.models import transformer
-from mdi_llm_tpu.ops.sampling import sample
+from mdi_llm_tpu.ops.sampling import (
+    sample,
+    sample_mode,
+    sample_traced,
+    sampling_operands,
+)
 from mdi_llm_tpu.serving.kv_pool import KVPool
 from mdi_llm_tpu.serving.scheduler import Request, Scheduler, SequenceState
 
@@ -123,6 +128,14 @@ class ServingEngine:
             gen.cfg, num_blocks, bs, dtype=gen.cache_dtype
         )
         self._fns: Dict[Any, Any] = {}
+        # sampling knobs are engine-lifetime constants: upload the traced
+        # operands once, not two tiny transfers per decode step
+        self._t_op, self._p_op = sampling_operands(
+            serving.temperature, serving.top_p
+        )
+        self._sample_mode = sample_mode(
+            serving.temperature, serving.top_k, serving.top_p
+        )
         self.stats = ServingStats()
         self._results: Dict[str, List[int]] = {}
         self._stream_cb = None
@@ -154,12 +167,15 @@ class ServingEngine:
         if key_ not in self._fns:
             gen = self.gen
 
+            # float knobs ride as traced operands; the cache keys only on
+            # (mode, top_k) — a per-request temperature sweep would otherwise
+            # compile one decode executable per distinct float
             @partial(
                 jax.jit, donate_argnums=(2,),
-                static_argnames=("temperature", "top_k", "top_p"),
+                static_argnames=("mode", "top_k"),
             )
             def decode(params, tok, kv, tables, input_pos, key,
-                       temperature, top_k, top_p):
+                       temperature, top_p, mode, top_k):
                 logits, kv = transformer.forward(
                     gen.cfg, params, tok[:, None], input_pos, kv=kv,
                     rope=gen.rope, moe_impl=gen._moe_impl,
@@ -167,9 +183,9 @@ class ServingEngine:
                     paged_kernel=self.cfg.use_kernel,
                 )
                 key, sub = jax.random.split(key)
-                nxt = sample(
-                    logits[:, -1], sub, temperature=temperature,
-                    top_k=top_k, top_p=top_p,
+                nxt = sample_traced(
+                    logits[:, -1], sub, temperature, top_p,
+                    mode=mode, top_k=top_k,
                 )
                 return nxt.astype(jnp.int32), kv, key
 
@@ -305,9 +321,8 @@ class ServingEngine:
         try:
             nxt, self._kv, self.gen.key = self._decode_fn(B)(
                 self.gen.params, jnp.asarray(tok), kv, jnp.asarray(tables),
-                jnp.asarray(pos), self.gen.key,
-                temperature=self.cfg.temperature, top_k=self.cfg.top_k,
-                top_p=self.cfg.top_p,
+                jnp.asarray(pos), self.gen.key, self._t_op, self._p_op,
+                mode=self._sample_mode, top_k=self.cfg.top_k,
             )
         except Exception:
             self._kv = kv  # see _run_prefill: keep failures diagnosable

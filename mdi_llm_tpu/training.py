@@ -731,6 +731,7 @@ class Trainer:
     def _pp_tree_to_standard(self, tree):
         std = {k: v for k, v in tree.items() if k != "stage_blocks"}
         std["blocks"] = unpad_stage_blocks(
+            # mdi-lint: disable-next-line=host-sync -- checkpoint path: params must land on host anyway, one batched pull per save
             jax.device_get(tree["stage_blocks"]), self.pp_counts
         )
         return std

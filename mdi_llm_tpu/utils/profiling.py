@@ -1,9 +1,16 @@
-"""Profiling helpers: XLA device traces + host cProfile.
+"""Profiling helpers: XLA device traces, host cProfile, and CompileGuard.
 
 The reference's only profiler is cProfile behind `--debug`
 (`/root/reference/src/sample.py:34-37,272-276`); here the same flag also
 captures a `jax.profiler` device trace (viewable in TensorBoard /
 Perfetto) — the TPU-native upgrade called out in SURVEY.md §7.
+
+`CompileGuard` is the runtime companion to the `mdi-lint` static rules
+(docs/analysis.md): it counts jit traces and XLA backend compiles via
+`jax.monitoring`, so a bench run can PROVE the steady state — after
+warmup, a hot decode loop must never compile again.  bench.py fails its
+decode rows on any post-warmup recompile and records the counts in every
+row's `detail.compiles` (docs/perf.md "Compile stability").
 """
 
 from __future__ import annotations
@@ -11,9 +18,131 @@ from __future__ import annotations
 import cProfile
 import contextlib
 from pathlib import Path
-from typing import Iterator, Optional, Union
+from typing import Dict, Iterator, List, Optional, Union
 
 PathLike = Union[str, Path]
+
+# jax.monitoring event keys (jax/_src/dispatch.py): one JAXPR_TRACE per new
+# jit cache entry, one BACKEND_COMPILE per XLA compilation.  Tracking BOTH
+# matters: with a persistent compilation cache a recompile can be a cheap
+# cache hit (trace fires, backend compile doesn't) — but it still means the
+# jit cache missed, which on a hot path is the bug.
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_active_guards: List["CompileGuard"] = []
+_listener_installed = False
+
+
+def _dispatch_event(event: str, duration: float, **kwargs) -> None:
+    for guard in _active_guards:
+        guard._observe(event)
+
+
+def _install_listener() -> None:
+    """Register ONE process-wide listener lazily (jax.monitoring has no
+    unregister; the dispatcher is a no-op while no guard is active)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    from jax import monitoring
+
+    monitoring.register_event_duration_secs_listener(_dispatch_event)
+    _listener_installed = True
+
+
+class RecompileError(RuntimeError):
+    """A jitted function compiled again after the warmup boundary."""
+
+
+class CompileGuard:
+    """Count jit traces / XLA compiles within a region, with a warmup mark.
+
+    Usage::
+
+        guard = CompileGuard(label="decode")
+        with guard:
+            engine.generate(prompts, n, temperature=0.0)   # warmup compiles
+            guard.mark_warm()
+            engine.generate(prompts, n, temperature=0.0)   # steady state
+        guard.expect_clean()   # raises RecompileError if anything compiled
+
+    Counters are process-wide (jax.monitoring does not attribute events to
+    functions), which is exactly the invariant a bench wants: NOTHING in
+    the steady-state region may build a new executable.  Guards nest
+    safely; each keeps independent counts.
+    """
+
+    def __init__(self, label: str = "", max_recompiles_after_warmup: int = 0):
+        self.label = label
+        self.max_recompiles_after_warmup = int(max_recompiles_after_warmup)
+        self.traces = 0
+        self.backend_compiles = 0
+        self._warm_traces: Optional[int] = None
+        self._warm_backend: Optional[int] = None
+
+    # -- context manager -----------------------------------------------------
+
+    def __enter__(self) -> "CompileGuard":
+        _install_listener()
+        _active_guards.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _active_guards.remove(self)
+
+    # -- event sink ----------------------------------------------------------
+
+    def _observe(self, event: str) -> None:
+        if event == _TRACE_EVENT:
+            self.traces += 1
+        elif event == _BACKEND_COMPILE_EVENT:
+            self.backend_compiles += 1
+
+    # -- warmup boundary -----------------------------------------------------
+
+    def mark_warm(self) -> None:
+        """Everything compiled so far is warmup; later compiles are suspect."""
+        self._warm_traces = self.traces
+        self._warm_backend = self.backend_compiles
+
+    @property
+    def traces_after_warmup(self) -> Optional[int]:
+        if self._warm_traces is None:
+            return None
+        return self.traces - self._warm_traces
+
+    @property
+    def backend_compiles_after_warmup(self) -> Optional[int]:
+        if self._warm_backend is None:
+            return None
+        return self.backend_compiles - self._warm_backend
+
+    def summary(self) -> Dict[str, Optional[int]]:
+        """JSON-ready counters (recorded per bench row in BENCH_*.json)."""
+        return {
+            "traces": self.traces,
+            "backend_compiles": self.backend_compiles,
+            "traces_after_warmup": self.traces_after_warmup,
+            "backend_compiles_after_warmup": self.backend_compiles_after_warmup,
+        }
+
+    def expect_clean(self) -> None:
+        """Raise RecompileError if the post-warmup region compiled anything
+        beyond the allowance (default 0).  No-op if mark_warm was never
+        called (there is no steady-state region to judge)."""
+        after = self.traces_after_warmup
+        if after is None:
+            return
+        if after > self.max_recompiles_after_warmup:
+            name = f" [{self.label}]" if self.label else ""
+            raise RecompileError(
+                f"CompileGuard{name}: {after} jit trace(s) "
+                f"({self.backend_compiles_after_warmup} backend compile(s)) "
+                "after warmup — the steady state is recompiling; check for "
+                "float static args, shape drift, or jit-in-loop "
+                "(run `mdi-lint` / see docs/analysis.md)"
+            )
 
 
 @contextlib.contextmanager

@@ -1,0 +1,163 @@
+"""CompileGuard (utils/profiling.py): the runtime half of the mdi-lint
+story — prove on a live trace that the post-warmup steady state never
+builds a new executable, and that the traced-sampling refactor actually
+bought what static-float-arg promises: sweeping temperature/top_p reuses
+one decode executable.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.config import Config
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.ops.sampling import (
+    sample,
+    sample_mode,
+    sample_traced,
+    sampling_operands,
+)
+from mdi_llm_tpu.utils.profiling import CompileGuard, RecompileError
+
+
+def test_guard_counts_and_clean_steady_state():
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    g = CompileGuard(label="t")
+    with g:
+        f(jnp.ones((4,))).block_until_ready()
+        g.mark_warm()
+        f(jnp.ones((4,))).block_until_ready()
+    assert g.traces >= 1
+    assert g.traces_after_warmup == 0
+    assert g.backend_compiles_after_warmup == 0
+    g.expect_clean()  # must not raise
+    s = g.summary()
+    assert s["traces_after_warmup"] == 0 and s["traces"] == g.traces
+
+
+def test_guard_flags_post_warmup_recompile():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    g = CompileGuard(label="t")
+    with g:
+        f(jnp.ones((4,)))
+        g.mark_warm()
+        f(jnp.ones((6,)))  # new shape -> retrace
+    assert g.traces_after_warmup > 0
+    with pytest.raises(RecompileError, match="after warmup"):
+        g.expect_clean()
+
+
+def test_guard_without_warmup_mark_is_lenient():
+    g = CompileGuard()
+    with g:
+        jax.jit(lambda x: x - 1)(jnp.ones((3,)))
+    assert g.traces_after_warmup is None
+    g.expect_clean()  # no steady-state region declared: no-op
+
+
+def test_guard_allowance():
+    @jax.jit
+    def f(x):
+        return x * 3
+
+    g = CompileGuard(max_recompiles_after_warmup=8)
+    with g:
+        g.mark_warm()
+        f(jnp.ones((5,)))
+    assert g.traces_after_warmup >= 1
+    g.expect_clean()  # within the allowance
+
+
+def test_guards_nest_independently():
+    @jax.jit
+    def f(x):
+        return x * 5
+
+    outer = CompileGuard()
+    with outer:
+        f(jnp.ones((7,)))
+        inner = CompileGuard()
+        with inner:
+            f(jnp.ones((7,)))  # cached: no new trace
+        assert inner.traces == 0
+    assert outer.traces >= 1
+
+
+# ---------------------------------------------------------------------------
+# the static-float-arg fix, measured: distinct sampling floats share one
+# decode executable; and sample_traced is draw-identical to sample
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "temperature,top_k,top_p",
+    [(0.0, None, None), (0.8, None, None), (0.8, 5, None), (0.7, None, 0.9)],
+)
+def test_sample_traced_matches_sample(temperature, top_k, top_p):
+    key = jax.random.PRNGKey(3)
+    logits = jax.random.normal(jax.random.PRNGKey(4), (6, 64)) * 3.0
+    want = sample(logits, key, temperature=temperature, top_k=top_k, top_p=top_p)
+    t_op, p_op = sampling_operands(temperature, top_p)
+    got = sample_traced(
+        logits, key, t_op, p_op,
+        mode=sample_mode(temperature, top_k, top_p), top_k=top_k,
+    )
+    assert np.array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_sample_mode_dispatch_order_matches_sample():
+    assert sample_mode(0.0, 5, 0.9) == "greedy"      # temperature wins
+    assert sample_mode(0.8, 5, 0.9) == "top_p"       # top-p beats top-k
+    assert sample_mode(0.8, 5, None) == "top_k"
+    assert sample_mode(0.8, None, 1.0) == "top_k"    # top_p=1.0 -> disabled
+
+
+def _tiny_generator():
+    cfg = Config(
+        name="lint-tiny", block_size=64, vocab_size=128, n_layer=2, n_head=2,
+        n_embd=32, n_query_groups=2, intermediate_size=64,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return Generator(cfg, params, max_seq_length=64)
+
+
+def test_temperature_sweep_reuses_one_decode_executable():
+    """The satellite fix itself: decode at temperature 0.7 then 0.9 (same
+    mode, different float) must NOT retrace — before the refactor each
+    distinct float was a static arg and compiled its own executable."""
+    gen = _tiny_generator()
+    prompts = [[5, 9, 2, 7]]
+    gen.generate(prompts, 4, temperature=0.7, top_k=None)  # compile everything
+    guard = CompileGuard(label="temp-sweep")
+    with guard:
+        guard.mark_warm()
+        gen.generate(prompts, 4, temperature=0.9, top_k=None)
+        gen.generate(prompts, 4, temperature=1.3, top_k=None)
+    assert guard.traces_after_warmup == 0, (
+        "distinct temperatures retraced the decode fn — float knobs leaked "
+        "back into the jit cache key"
+    )
+    guard.expect_clean()
+
+
+def test_greedy_decode_steady_state_is_compile_free():
+    """The bench.py --mode decode contract at test scale: after a warmup
+    generate(), an identical generate() performs ZERO jit traces."""
+    gen = _tiny_generator()
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]]
+    gen.generate(prompts, 6, temperature=0.0)  # warmup
+    guard = CompileGuard(label="decode-steady")
+    with guard:
+        guard.mark_warm()
+        out, _ = gen.generate(prompts, 6, temperature=0.0)
+    assert len(out) == 2
+    assert guard.traces_after_warmup == 0
+    guard.expect_clean()

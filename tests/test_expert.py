@@ -243,7 +243,7 @@ def test_generator_ep_decode_parity(devices):
     lowered = decode.lower(
         eng.params, jnp.zeros((2, 1), jnp.int32), kv,
         jnp.zeros((2,), jnp.int32), _jax.random.PRNGKey(0),
-        temperature=0.0, top_k=None, top_p=None,
+        jnp.float32(1.0), jnp.float32(1.0), mode="greedy", top_k=None,
     )
     txt = lowered.as_text()
     assert "all_to_all" in txt or "all-to-all" in txt

@@ -1,0 +1,475 @@
+"""mdi-lint: per-rule fixtures (every rule has a triggering and a passing
+snippet), suppression + baseline workflow, the CLI surface, and the repo
+self-check — `mdi-lint mdi_llm_tpu/` must exit clean against the committed
+baseline, which makes this file the tier-1 CI gate the linter ships as.
+
+Also pins the CompileGuard <-> sampling contract the linter's static rules
+are paired with: `sample_traced` (traced float knobs, static mode) is
+draw-identical to `sample`, and a decode loop re-run at a DIFFERENT
+temperature must not retrace (the static-float-arg fix, measurable).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mdi_llm_tpu.analysis import Baseline, RULES, lint_paths, lint_source
+from mdi_llm_tpu.analysis.cli import main as lint_main
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def lint_rule(src, rule):
+    """Findings of one rule on a snippet (other rules can't interfere)."""
+    return lint_source(src, path="ops/snippet.py" if rule == "missing-named-scope"
+                       else "snippet.py", select=[rule])
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: each rule triggers on its bad snippet, stays silent on
+# the good twin
+# ---------------------------------------------------------------------------
+
+BAD = {
+    "host-sync-in-jit": """
+import jax
+
+@jax.jit
+def f(x):
+    y = x * 2
+    return y.item()
+""",
+    "host-sync": """
+import jax
+
+def collect(emits):
+    for e in emits:
+        out = jax.device_get(e)
+    return out
+""",
+    "tracer-branch": """
+import jax
+
+@jax.jit
+def f(x, n):
+    if n > 0:
+        return x * n
+    return x
+""",
+    "donation-after-use": """
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(kv, tok):
+    return kv + tok
+
+def loop(kv, tok):
+    out = step(kv, tok)
+    return kv.sum()
+""",
+    "static-float-arg": """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("temperature",))
+def decode(x, temperature):
+    return x / temperature
+""",
+    "jit-in-loop": """
+import jax
+
+def run(xs):
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)
+        x = f(x)
+    return x
+""",
+    "lax-scalar-operand": """
+from jax import lax
+
+def f(x):
+    return lax.add(x, 1.0)
+""",
+    "mutable-global-in-jit": """
+import jax
+
+TABLE = {"scale": 2.0}
+
+@jax.jit
+def f(x):
+    return x * TABLE["scale"]
+""",
+    "missing-named-scope": """
+import jax
+import jax.numpy as jnp
+
+def fused_kernel(q, k, v):
+    s = jnp.einsum("bth,bsh->bts", q, k)
+    s = s * jnp.asarray(0.125, s.dtype)
+    s = s - jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    p = p.astype(v.dtype)
+    o = jnp.einsum("bts,bsh->bth", p, v)
+    o = jnp.tanh(o)
+    return jnp.reshape(o, o.shape)
+""",
+}
+
+GOOD = {
+    "host-sync-in-jit": """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return jnp.sum(x * 2)
+
+def host_side(y):
+    return y.item()  # outside jit: fine for this rule
+""",
+    "host-sync": """
+import jax
+
+def collect(emits):
+    return jax.device_get(emits)  # mdi-lint: disable=host-sync -- one batched fetch
+""",
+    "tracer-branch": """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    if n > 0:          # static: fine
+        return x * n
+    if x.ndim == 2:    # shape check on a tracer: concrete, fine
+        return x
+    return x
+
+@jax.jit
+def g(x, y):
+    if y is None:      # structure check: fine
+        return x
+    return x + y
+""",
+    "donation-after-use": """
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(kv, tok):
+    return kv + tok
+
+def loop(kv, tok):
+    kv = step(kv, tok)   # rebound by the donating call itself
+    return kv.sum()
+""",
+    "static-float-arg": """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("mode", "top_k"))
+def decode(x, temperature, mode, top_k):
+    return x / temperature   # temperature is traced; mode/top_k key the cache
+""",
+    "jit-in-loop": """
+import jax
+
+f = jax.jit(lambda v: v * 2)
+
+def run(xs):
+    for x in xs:
+        x = f(x)
+    return x
+""",
+    "lax-scalar-operand": """
+import jax.numpy as jnp
+from jax import lax
+
+def f(x):
+    return lax.add(x, jnp.asarray(1.0, x.dtype))
+""",
+    "mutable-global-in-jit": """
+import jax
+
+SCALE = 2.0  # immutable module constant: fine
+
+@jax.jit
+def f(x, table):
+    return x * table["scale"] * SCALE
+""",
+    "missing-named-scope": """
+import jax
+import jax.numpy as jnp
+
+def fused_kernel(q, k, v):
+    with jax.named_scope("fused_kernel"):
+        s = jnp.einsum("bth,bsh->bts", q, k)
+        s = s * jnp.asarray(0.125, s.dtype)
+        s = s - jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        p = p.astype(v.dtype)
+        o = jnp.einsum("bts,bsh->bth", p, v)
+        o = jnp.tanh(o)
+        return jnp.reshape(o, o.shape)
+
+def _private_helper(q, k, v):
+    return fused_kernel(q, k, v)  # private: exempt
+""",
+}
+
+
+def test_every_shipped_rule_has_fixtures():
+    assert set(BAD) == set(RULES), "add fixtures for every registered rule"
+    assert set(GOOD) == set(RULES)
+
+
+@pytest.mark.parametrize("rule", sorted(BAD))
+def test_rule_triggers_on_bad_fixture(rule):
+    findings = lint_rule(BAD[rule], rule)
+    assert findings, f"{rule} missed its bad fixture"
+    assert all(f.rule == rule for f in findings)
+    assert all(f.line >= 1 and f.message for f in findings)
+
+
+@pytest.mark.parametrize("rule", sorted(GOOD))
+def test_rule_passes_on_good_fixture(rule):
+    assert lint_rule(GOOD[rule], rule) == [], f"{rule} false-positived"
+
+
+def test_rule_registry_is_documented():
+    for r in RULES.values():
+        assert r.summary, f"{r.name} has no summary"
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_same_line_suppression_silences_only_that_rule():
+    src = """
+import jax
+
+def collect(e):
+    return jax.device_get(e)  # mdi-lint: disable=host-sync -- intended sync
+"""
+    assert lint_source(src, select=["host-sync"]) == []
+    # a different rule name does NOT silence it
+    src2 = src.replace("disable=host-sync", "disable=tracer-branch")
+    assert rules_of(lint_source(src2, select=["host-sync"])) == ["host-sync"]
+
+
+def test_disable_next_line_and_disable_all():
+    src = """
+import jax
+
+def collect(e):
+    # mdi-lint: disable-next-line=host-sync -- one batched fetch per chunk
+    x = jax.device_get(e)
+    y = jax.device_get(e)  # mdi-lint: disable=all
+    return x, y
+"""
+    assert lint_source(src, select=["host-sync"]) == []
+
+
+def test_unsuppressed_line_still_reported():
+    src = """
+import jax
+
+def collect(e):
+    x = jax.device_get(e)  # mdi-lint: disable=host-sync -- ok
+    y = jax.device_get(e)
+    return x, y
+"""
+    findings = lint_source(src, select=["host-sync"])
+    assert len(findings) == 1 and findings[0].line == 6
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+VIOLATION = """
+import jax
+
+def collect(e):
+    return jax.device_get(e)
+"""
+
+SECOND_VIOLATION = """
+import jax
+
+def collect(e):
+    return jax.device_get(e)
+
+def collect2(e):
+    return jax.device_get(list(e))
+"""
+
+
+def test_baseline_grandfathers_then_new_violation_fails(tmp_path, capsys):
+    mod = tmp_path / "pkg" / "mod.py"
+    mod.parent.mkdir()
+    mod.write_text(VIOLATION)
+    baseline = tmp_path / "baseline.json"
+
+    # --update-baseline grandfathers the existing finding -> clean exit
+    rc = lint_main([str(mod), "--baseline", str(baseline), "--update-baseline"])
+    assert rc == 0 and baseline.exists()
+    rc = lint_main([str(mod), "--baseline", str(baseline)])
+    assert rc == 0
+
+    # adding a NEW violation (different line text) fails despite the baseline
+    mod.write_text(SECOND_VIOLATION)
+    rc = lint_main([str(mod), "--baseline", str(baseline)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "host-sync" in out and "grandfathered" in out
+
+
+def test_update_baseline_round_trips(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(SECOND_VIOLATION)
+    baseline = tmp_path / "baseline.json"
+    rc = lint_main([str(mod), "--baseline", str(baseline), "--update-baseline"])
+    assert rc == 0
+    first = json.loads(baseline.read_text())
+    # round-trip: updating again from the same tree is a fixed point…
+    rc = lint_main([str(mod), "--baseline", str(baseline), "--update-baseline"])
+    assert json.loads(baseline.read_text()) == first
+    # …and the tree lints clean against it
+    assert lint_main([str(mod), "--baseline", str(baseline)]) == 0
+    # fixing the code then regenerating empties the baseline
+    mod.write_text("x = 1\n")
+    lint_main([str(mod), "--baseline", str(baseline), "--update-baseline"])
+    assert json.loads(baseline.read_text())["findings"] == {}
+
+
+def test_baseline_split_counts_per_key():
+    findings = lint_source(SECOND_VIOLATION, select=["host-sync"])
+    assert len(findings) == 2
+    b = Baseline.from_findings(findings[:1])
+    new, old = b.split(findings)
+    assert len(old) == 1 and len(new) == 1
+
+
+def test_lint_root_under_hidden_dir_still_lints(tmp_path):
+    """Only dot-dirs BELOW the lint root are skipped — a checkout under
+    ~/.cache (or a .claude worktree) must not lint vacuously clean."""
+    root = tmp_path / ".hidden" / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "mod.py").write_text(VIOLATION)
+    (root / "pkg" / ".venv").mkdir()
+    (root / "pkg" / ".venv" / "skipme.py").write_text(VIOLATION)
+    findings, errors = lint_paths([root / "pkg"], root=root)
+    assert not errors
+    assert [f.path for f in findings] == ["pkg/mod.py"]  # .venv skipped
+
+
+def test_missing_path_is_an_error_not_clean(tmp_path, capsys):
+    findings, errors = lint_paths([tmp_path / "no_such_pkg"])
+    assert findings == [] and len(errors) == 1
+    assert "no such file" in errors[0]
+    rc = lint_main([str(tmp_path / "no_such_pkg")])
+    assert rc == 2  # a typo'd CI invocation must not exit 0
+
+
+def test_update_baseline_with_select_preserves_other_rules(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        VIOLATION + "\n"
+        "import jax as j\n\n"
+        "@j.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+    )
+    baseline = tmp_path / "baseline.json"
+    lint_main([str(mod), "--baseline", str(baseline), "--update-baseline"])
+    keys = set(json.loads(baseline.read_text())["findings"])
+    assert {k.split("::")[0] for k in keys} == {"host-sync", "host-sync-in-jit"}
+    # refreshing ONE rule must not discard the other rule's entries
+    lint_main([str(mod), "--baseline", str(baseline),
+               "--select", "host-sync", "--update-baseline"])
+    assert set(json.loads(baseline.read_text())["findings"]) == keys
+    assert lint_main([str(mod), "--baseline", str(baseline)]) == 0
+
+
+def test_baseline_survives_line_shift(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(VIOLATION)
+    baseline = tmp_path / "baseline.json"
+    lint_main([str(mod), "--baseline", str(baseline), "--update-baseline"])
+    # unrelated lines added above: same line TEXT, different line number
+    mod.write_text("import os\n\n" + VIOLATION)
+    assert lint_main([str(mod), "--baseline", str(baseline)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in RULES:
+        assert name in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert lint_main(["--select", "no-such-rule"]) == 2
+
+
+def test_cli_json_format(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text(VIOLATION)
+    rc = lint_main([str(mod), "--no-baseline", "--format", "json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"] and data["findings"][0]["rule"] == "host-sync"
+
+
+def test_cli_syntax_error_reported_not_crash(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    rc = lint_main([str(bad), "--no-baseline"])
+    assert rc == 2
+    assert "syntax error" in capsys.readouterr().err
+
+
+def test_module_entrypoint_runs():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mdi_llm_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0 and "static-float-arg" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the CI gate: the repo itself lints clean against the committed baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean_against_committed_baseline():
+    findings, errors = lint_paths([REPO / "mdi_llm_tpu"], root=REPO)
+    assert not errors
+    baseline = Baseline.load(REPO / ".mdi-lint-baseline.json")
+    new, _ = baseline.split(findings)
+    assert new == [], "new mdi-lint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_pyproject_registers_console_script():
+    txt = (REPO / "pyproject.toml").read_text()
+    assert 'mdi-lint = "mdi_llm_tpu.analysis.cli:main"' in txt
