@@ -137,6 +137,12 @@ def build_parser():
     )
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="direct mode: wrap the timed run in a jax.profiler trace")
+    ap.add_argument("--no-preflight", action="store_true",
+                    help="downgrade a failing mdi-audit preflight to a warning "
+                    "instead of refusing to launch the row")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM budget for the preflight audit "
+                    "(default: no budget check, structural checks only)")
     ap.add_argument("--scan-unroll", type=int, default=1,
                     help="layer-scan unroll factor (single-chip engine): "
                     "trades compile time for per-layer loop overhead")
@@ -146,6 +152,56 @@ def build_parser():
 # ---------------------------------------------------------------------------
 # Direct mode (one in-process measurement)
 # ---------------------------------------------------------------------------
+
+
+def run_preflight(args, cfg, mode):
+    """Static plan audit (mdi-audit) before any engine is built.
+
+    Pure host-side analysis over abstract shapes — no device, no compile
+    (the CompileGuard counters are untouched by construction).  ERROR
+    findings refuse the row unless --no-preflight downgrades them to a
+    warning; the returned dict is recorded as `detail.audit` so suite JSON
+    tracks predicted vs. configured footprint per row.
+    """
+    from mdi_llm_tpu.analysis.audit import (
+        audit_detail, enforce_preflight, preflight,
+    )
+    from mdi_llm_tpu.generation import _bucket, _run_cache_len
+
+    seq_len = min(args.seq_len, cfg.block_size)
+    serving, kv_len = None, None
+    if mode == "serve":
+        from mdi_llm_tpu.config import ServingConfig
+
+        serving = ServingConfig(
+            block_size=args.serve_block_size,
+            max_batch=args.batch,
+            prefill_chunk=min(128, args.seq_len // 2),
+        )
+        act_t = min(_bucket(max(1, min(128, args.seq_len // 2))), seq_len)
+    else:
+        total_max = args.prompt_len + (1 if mode == "prefill" else args.new_tokens)
+        act_t = min(_bucket(args.prompt_len), seq_len)
+        kv_len = _run_cache_len(seq_len, total_max, act_t)
+    report = preflight(
+        cfg,
+        n_stages=args.pipeline or 1,
+        pipeline=bool(args.pipeline) if mode == "decode" else False,
+        samples_per_slot=args.samples_per_slot,
+        n_samples=args.batch,
+        batch=args.batch,
+        seq_len=seq_len,
+        kv_seq_len=kv_len,
+        act_seq_len=act_t,
+        dtype=args.dtype,
+        cache_dtype=args.kv_dtype,
+        quantize=args.quantize,
+        serving=serving,
+        hbm_gb=args.hbm_gb,
+        origin=f"bench:{mode}",
+    )
+    enforce_preflight(report, "bench", allow=args.no_preflight)
+    return audit_detail(report)
 
 
 def run_probe():
@@ -281,6 +337,7 @@ def run_prefill(args):
     if jax.default_backend() != "tpu":
         print("warning: flash kernel needs TPU; both runs use the XLA path",
               file=sys.stderr, flush=True)
+    audit = run_preflight(args, cfg, "prefill")
 
     params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
     rng = np.random.default_rng(0)
@@ -362,6 +419,7 @@ def run_prefill(args):
             "flash_rel_err_vs_f32": round(err_f, 5),
             "xla_rel_err_vs_f32": round(err_x, 5),
             "argmax_agreement_bf16": round(agree, 5),
+            "audit": audit,
             "device": str(jax.devices()[0]),
         },
     }
@@ -394,6 +452,7 @@ def run_serve(args):
     cfg = Config.from_name(args.model)
     if args.pipeline:
         raise SystemExit("--mode serve runs the single-chip engine; drop --pipeline")
+    audit = run_preflight(args, cfg, "serve")
     if args.quantize != "none":
         from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, init_quantized_params
 
@@ -454,6 +513,7 @@ def run_serve(args):
             "kv_block_utilization_peak": round(stats.kv_utilization_peak, 4),
             "prefix_cache_hits": stats.prefix_cache_hits,
             "preemptions": stats.preemptions,
+            "audit": audit,
             "baseline_tokens_per_s": base,
             "config": {
                 "model": args.model, "slots": args.batch,
@@ -481,6 +541,7 @@ def run_decode(args):
              "float32": jnp.float32}[args.dtype]
     kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
     cfg = Config.from_name(args.model)
+    audit = run_preflight(args, cfg, "decode")
     if args.quantize != "none":
         # build the int8/int4 tree directly: an 8B-class model never exists
         # in f32/bf16, so Llama-3-8B fits one v5e chip for quantized benches
@@ -562,6 +623,7 @@ def run_decode(args):
             "decode_tokens_per_s": round(decode_tps, 2),
             "prefill_s": round(stats.prefill_s, 3),
             "wall_s": round(wall, 2),
+            "audit": audit,
             "baseline_tokens_per_s": base,
             "config": {
                 "model": args.model, "batch": args.batch, "chunk": args.chunk,

@@ -29,4 +29,24 @@ from mdi_llm_tpu.analysis.core import (  # noqa: F401
 )
 import mdi_llm_tpu.analysis.rules  # noqa: E402,F401  (populates RULES)
 
-__all__ = ["Baseline", "Finding", "Rule", "RULES", "lint_paths", "lint_source"]
+__all__ = [
+    "Baseline", "Finding", "Rule", "RULES", "lint_paths", "lint_source",
+    # mdi-audit (lazy: keeps bare mdi-lint free of the jax import)
+    "AUDIT_RULES", "AuditReport", "MeshSpec", "PlanSpec", "audit_plan",
+    "preflight",
+]
+
+_AUDIT_NAMES = {"AUDIT_RULES", "AuditReport", "audit_plan", "preflight"}
+_PLAN_NAMES = {"MeshSpec", "PlanSpec"}
+
+
+def __getattr__(name):
+    if name in _AUDIT_NAMES:
+        from mdi_llm_tpu.analysis import audit
+
+        return getattr(audit, name)
+    if name in _PLAN_NAMES:
+        from mdi_llm_tpu.analysis import plan
+
+        return getattr(plan, name)
+    raise AttributeError(name)

@@ -1,5 +1,17 @@
-"""`python -m mdi_llm_tpu.analysis` == `mdi-lint`."""
+"""`python -m mdi_llm_tpu.analysis` == `mdi-lint`;
+`python -m mdi_llm_tpu.analysis audit ...` == `mdi-audit`
+(an explicit leading `lint` is also accepted)."""
 
-from mdi_llm_tpu.analysis.cli import main
+import sys
 
-raise SystemExit(main())
+argv = sys.argv[1:]
+if argv[:1] == ["audit"]:
+    from mdi_llm_tpu.analysis.audit import main
+
+    raise SystemExit(main(argv[1:]))
+if argv[:1] == ["lint"]:
+    argv = argv[1:]
+
+from mdi_llm_tpu.analysis.cli import main  # noqa: E402
+
+raise SystemExit(main(argv))

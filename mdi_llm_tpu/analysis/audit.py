@@ -1,0 +1,944 @@
+"""`mdi-audit`: static plan auditor — evaluate a (Config, mesh, parallel
+plan, ServingConfig) tuple WITHOUT touching a device or compiling anything.
+
+Three checker families over the abstract-shape IR (`analysis/plan.py`):
+
+1. **Sharding consistency** — every `parallel/sharding.param_specs` leaf is
+   checked against the declared mesh: axis names exist, each sharded dim is
+   divisible by its axis size (heads % tp, experts % ep, vocab % tp where
+   the head shards, n_layer % stages via `partition.stage_layers`), no dim
+   uses one axis twice, and coverage is total — a params leaf with no spec
+   is an error, not silent replication.
+2. **Memory budgeting** — analytic per-device HBM footprint (params by
+   dtype/quantized storage layout, dense KV cache or paged pool from
+   `ServingConfig`, activation high-water mark, donation-aware) checked
+   against an optional `--hbm-gb` budget, with a per-component breakdown
+   and the max batch / max context that fits.
+3. **Schedule soundness** — symbolic execution of the stage-ring/ring-
+   attention permutation schedules: every ppermute send has a matching
+   recv (bijection), the ring is a single cycle (activations return to
+   stage 0), per-rank op traces are identical (SPMD deadlock-freedom), and
+   the paper's recurrent-pipeline invariant `n_samples >= n_stages` is
+   reported with the computed bubble fraction.
+
+Findings reuse the mdi-lint `Finding`/`Baseline` machinery (analysis/core.py)
+so both tools share one reporting pipeline.  Runnable as `mdi-audit` or
+`python -m mdi_llm_tpu.analysis audit`; `bench.py`, `mdi-serve` and
+`mdi-starter` call :func:`preflight` before building any engine and refuse
+(or warn, with ``--no-preflight``) to launch a failing plan.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mdi_llm_tpu.analysis.core import Baseline, Finding
+from mdi_llm_tpu.analysis.plan import (
+    MeshSpec,
+    PlanSpec,
+    abstract_params,
+    iter_leaves,
+    ring_permutation,
+)
+from mdi_llm_tpu.config import Config, ServingConfig, dtype_bytes
+
+__all__ = [
+    "AUDIT_RULES",
+    "AuditReport",
+    "audit_detail",
+    "audit_plan",
+    "enforce_preflight",
+    "preflight",
+    "main",
+]
+
+ERROR, WARNING = "error", "warning"
+
+# code -> (severity, summary).  ERROR findings make preflight refuse to
+# launch; WARNING findings are reported but never block.
+AUDIT_RULES: Dict[str, Tuple[str, str]] = {
+    "bad-mesh-axis": (
+        ERROR, "a declared mesh axis has size < 1 (make_mesh rejects it; "
+        "resolve -1 inference to a concrete size before auditing)"),
+    "unknown-mesh-axis": (
+        ERROR, "a PartitionSpec references an axis the mesh does not declare "
+        "(the runtime silently replicates instead of sharding)"),
+    "indivisible-dim": (
+        ERROR, "a sharded dimension is not divisible by its mesh axis size"),
+    "duplicate-axis": (
+        ERROR, "one leaf shards two dimensions on the same mesh axis"),
+    "missing-spec": (
+        ERROR, "a params leaf has no PartitionSpec (silent full replication)"),
+    "stale-spec": (
+        WARNING, "param_specs names a leaf the params tree does not have"),
+    "spec-rank-mismatch": (
+        ERROR, "a PartitionSpec has more entries than the leaf has dims"),
+    "bad-stage-split": (
+        ERROR, "the layer->stage partition is invalid (empty stage or "
+        "n_stages > n_layer)"),
+    "hbm-over-budget": (
+        ERROR, "the analytic per-device footprint exceeds the HBM budget"),
+    "unmatched-permute": (
+        ERROR, "a ppermute schedule has a send without a matching recv "
+        "(not a permutation of the ranks)"),
+    "broken-ring": (
+        ERROR, "the ring permutation is a bijection but not one cycle — "
+        "activations never return to stage 0"),
+    "schedule-divergence": (
+        ERROR, "ranks execute different collective sequences (deadlock)"),
+    "pipeline-underfill": (
+        WARNING, "n_samples < n_stages: the recurrent ring runs with "
+        "bubbles (paper invariant, MDI-LLM README)"),
+    "bad-serving-config": (
+        ERROR, "the paged-KV ServingConfig cannot be instantiated"),
+}
+
+GiB = float(1 << 30)
+
+
+@dataclasses.dataclass
+class AuditReport:
+    plan: PlanSpec
+    findings: List[Finding]
+    breakdown: Dict[str, Any]
+
+    def severity(self, f: Finding) -> str:
+        return AUDIT_RULES.get(f.rule, (ERROR, ""))[0]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if self.severity(f) == ERROR]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if self.severity(f) == WARNING]
+
+    def render_findings(self) -> List[str]:
+        return [
+            f"{f.path}: {self.severity(f)}: {f.rule}: {f.message}"
+            for f in self.findings
+        ]
+
+    def render_text(self) -> str:
+        lines = [f"plan: {self.plan.describe()}"]
+        dev = self.breakdown.get("per_device", {})
+        if dev:
+            lines.append("per-device HBM footprint:")
+            for k in ("params_bytes", "kv_bytes", "act_bytes", "total_bytes"):
+                label = k.replace("_bytes", "").replace("act", "activations")
+                lines.append(f"  {label:<12} {dev[k] / GiB:9.3f} GiB")
+            budget = self.breakdown.get("budget_bytes")
+            if budget:
+                lines.append(
+                    f"  budget       {budget / GiB:9.3f} GiB "
+                    f"({self.breakdown['budget_utilization']:.0%} used)"
+                )
+                fits = self.breakdown.get("fits", {})
+                if fits:
+                    lines.append(
+                        "  fits: " + ", ".join(f"{k}={v}" for k, v in fits.items())
+                    )
+        if self.breakdown.get("stage_layers"):
+            lines.append(f"stage layers: {self.breakdown['stage_layers']}")
+        if "bubble_fraction" in self.breakdown:
+            lines.append(
+                f"ring lanes: {self.breakdown['ring_lanes']} "
+                f"(bubble fraction {self.breakdown['bubble_fraction']:.2f})"
+            )
+        if self.findings:
+            lines.extend(self.render_findings())
+        else:
+            lines.append("findings: none")
+        return "\n".join(lines)
+
+    def as_json(self) -> Dict[str, Any]:
+        return {
+            "plan": self.plan.describe(),
+            "findings": [
+                {**f.__dict__, "severity": self.severity(f)} for f in self.findings
+            ],
+            "errors": len(self.errors),
+            "warnings": len(self.warnings),
+            "breakdown": self.breakdown,
+        }
+
+
+def _finding(plan: PlanSpec, code: str, message: str) -> Finding:
+    assert code in AUDIT_RULES, code
+    return Finding(
+        rule=code, path=plan.origin, line=0, col=0,
+        message=message, line_text=plan.describe(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# checker 1: sharding consistency
+# ---------------------------------------------------------------------------
+
+
+def _axes_of(entry) -> Tuple[str, ...]:
+    """PartitionSpec entry → axis names (None → (), 'tp' → ('tp',),
+    ('dp','tp') → both)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def _spec_axis_names(specs) -> List[str]:
+    names: List[str] = []
+    for _, spec in iter_leaves(specs):
+        for entry in tuple(spec):
+            for ax in _axes_of(entry):
+                if ax not in names:
+                    names.append(ax)
+    return names
+
+
+def _check_mesh(plan: PlanSpec, findings: List[Finding]) -> None:
+    """Every declared axis size must be a concrete >= 1 — the IR can
+    represent a nonsensical mesh, but the audit must flag it: every
+    divisibility/memory check below is vacuous at size <= 1, so a 0 or -1
+    axis would otherwise audit green and then die in `make_mesh`."""
+    for name, size in plan.mesh.axes:
+        if size < 1:
+            findings.append(_finding(
+                plan, "bad-mesh-axis",
+                f"mesh axis {name!r} has size {size}; sizes must be >= 1 "
+                "(the runtime's make_mesh rejects this mesh — pass the "
+                "resolved size instead of -1 inference)",
+            ))
+
+
+def _check_sharding(plan: PlanSpec, findings: List[Finding]) -> None:
+    from mdi_llm_tpu.parallel.sharding import adapt_specs_to_tree, param_specs
+
+    cfg, mesh = plan.cfg, plan.mesh
+    specs = param_specs(cfg, tp_axis=plan.tp_axis, ep_axis=plan.ep_axis)
+    shapes = abstract_params(cfg, plan.dtype)  # standard (semantic) layout
+
+    # -- axis existence: one finding per axis the mesh does not declare ----
+    unknown = [a for a in _spec_axis_names(specs) if a not in mesh.names]
+    for a in unknown:
+        findings.append(_finding(
+            plan, "unknown-mesh-axis",
+            f"plan shards on axis {a!r} but the mesh ({mesh.describe()}) "
+            "does not declare it — the runtime would silently replicate "
+            "every leaf sharded on it (shard_params drops unknown axes)",
+        ))
+    unknown_set = set(unknown)
+
+    # -- coverage + per-leaf divisibility/duplicates -----------------------
+    missing: List[str] = []
+    stale: List[str] = []
+    indiv: Dict[str, List[str]] = {}
+    dups: Dict[str, List[str]] = {}
+
+    def leaf_paths(node, path):
+        return [p for p, _ in iter_leaves(node, path)]
+
+    def walk(spec_node, shape_node, path, check_div):
+        if isinstance(shape_node, dict):
+            if not isinstance(spec_node, dict):
+                missing.extend(leaf_paths(shape_node, path))
+                return
+            for k, v in shape_node.items():
+                sub = f"{path}.{k}" if path else k
+                if k not in spec_node:
+                    missing.extend(leaf_paths(v, sub))
+                else:
+                    walk(spec_node[k], v, sub, check_div)
+            for k in spec_node:
+                if k not in shape_node:
+                    stale.append(f"{path}.{k}" if path else k)
+            return
+        # leaf
+        entries = tuple(spec_node) if not isinstance(spec_node, dict) else None
+        if entries is None:
+            missing.append(path)
+            return
+        shape = np.shape(shape_node)
+        if len(entries) > len(shape):
+            findings.append(_finding(
+                plan, "spec-rank-mismatch",
+                f"{path}: spec {entries} has {len(entries)} entries but the "
+                f"leaf has shape {shape}",
+            ))
+            return
+        seen: Dict[str, int] = {}
+        for i, entry in enumerate(entries):
+            for ax in _axes_of(entry):
+                if ax in seen:
+                    dups.setdefault(ax, []).append(
+                        f"{path} dims {seen[ax]} and {i}"
+                    )
+                else:
+                    seen[ax] = i
+                if ax in unknown_set or not check_div:
+                    continue
+                size = mesh.size(ax)
+                if size > 1 and shape[i] % size:
+                    indiv.setdefault(ax, []).append(
+                        f"{path} dim{i}={shape[i]}"
+                    )
+
+    # head/embedding leaves replicate in the pipeline engine: their specs
+    # only bind when the Generator mesh path consumes the plan
+    def check_div_for(key):
+        return plan.shard_head or key == "blocks"
+
+    for k, v in shapes.items():
+        if k in specs:
+            walk(specs[k], v, k, check_div_for(k))
+        else:
+            missing.extend(leaf_paths(v, k))
+    for k in specs:
+        if k not in shapes:
+            stale.append(k)
+
+    # -- semantic dims (mirror parallel.sharding.validate_tp_divisibility):
+    # head/group counts must divide even when the fused leaf dim happens to
+    # (the interleaved qkv layout makes a divisible row count insufficient)
+    t = plan.tp_axis
+    if t and t in mesh.names and mesh.size(t) > 1:
+        tp = mesh.size(t)
+        moe = cfg.mlp_class_name == "LLaMAMoE"
+        dims = [("n_head", cfg.n_head), ("n_query_groups", cfg.n_query_groups)]
+        if not moe:
+            dims.append(("intermediate_size", cfg.intermediate_size))
+        if plan.shard_head:
+            dims.append(("padded_vocab_size", cfg.padded_vocab_size))
+        for name, dim in dims:
+            if dim % tp:
+                indiv.setdefault(t, []).insert(0, f"{name}={dim}")
+    e = plan.ep_axis or plan.tp_axis
+    if (cfg.mlp_class_name == "LLaMAMoE" and e and e in mesh.names
+            and mesh.size(e) > 1 and cfg.n_expert % mesh.size(e)):
+        indiv.setdefault(e, []).insert(0, f"n_expert={cfg.n_expert}")
+    sp = plan.sp_axis
+    if sp and sp in mesh.names and mesh.size(sp) > 1 and plan.seq_len % mesh.size(sp):
+        indiv.setdefault(sp, []).insert(
+            0, f"sequence length {plan.seq_len} (ring attention chunks)"
+        )
+
+    # aggregate: ONE finding per axis / per failure family, so one root
+    # cause (e.g. heads % tp) reads as one actionable report
+    for ax, items in indiv.items():
+        shown = items[:6] + ([f"... {len(items) - 6} more"] if len(items) > 6 else [])
+        findings.append(_finding(
+            plan, "indivisible-dim",
+            f"mesh axis {ax!r} (size {mesh.size(ax)}) does not divide: "
+            + "; ".join(shown),
+        ))
+    for ax, items in dups.items():
+        findings.append(_finding(
+            plan, "duplicate-axis",
+            f"mesh axis {ax!r} used on two dims of one leaf: "
+            + "; ".join(items[:6]),
+        ))
+    for p in missing:
+        findings.append(_finding(
+            plan, "missing-spec",
+            f"params leaf {p!r} has no PartitionSpec — it would be "
+            "silently fully replicated on every device",
+        ))
+    for p in stale:
+        findings.append(_finding(
+            plan, "stale-spec",
+            f"param_specs names {p!r} but the params tree has no such leaf",
+        ))
+
+    # -- quantized storage coverage: the adapted specs must still cover the
+    # int8/int4 layout (weight_q*/scale leaves inherit the weight's spec)
+    if plan.quantize and plan.quantize != "none" and not missing:
+        storage = abstract_params(cfg, plan.dtype, plan.quantize)
+        adapted = adapt_specs_to_tree(specs, storage, axis_sizes=mesh.sizes)
+        for (p, _), (_, spec) in zip(iter_leaves(storage), iter_leaves(adapted)):
+            if spec is None:
+                findings.append(_finding(
+                    plan, "missing-spec",
+                    f"quantized storage leaf {p!r} has no adapted spec",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# checker 2: memory budgeting
+# ---------------------------------------------------------------------------
+
+
+def _sharded_nbytes(leaf, spec, sizes: Dict[str, int]) -> int:
+    """Per-device bytes of a leaf under its PartitionSpec: divide by every
+    axis size that actually divides its dim (indivisible shardings are
+    dropped by the runtime — `adapt_specs_to_tree` — so count them whole)."""
+    denom = 1
+    shape = np.shape(leaf)
+    for i, entry in enumerate(tuple(spec)[: len(shape)]):
+        for ax in _axes_of(entry):
+            s = sizes.get(ax, 1)
+            if s > 1 and shape[i] % s == 0:
+                denom *= s
+    return int(leaf.nbytes) // denom
+
+
+def _check_memory(
+    plan: PlanSpec, findings: List[Finding], breakdown: Dict[str, Any]
+) -> None:
+    from mdi_llm_tpu.parallel.partition import stage_layers
+    from mdi_llm_tpu.parallel.sharding import adapt_specs_to_tree, param_specs
+
+    cfg, mesh = plan.cfg, plan.mesh
+    sizes = mesh.sizes
+    par_item = dtype_bytes(plan.dtype)
+    kv_item = dtype_bytes(plan.kv_dtype)
+    storage = abstract_params(cfg, plan.dtype, plan.quantize)
+    try:
+        specs = adapt_specs_to_tree(
+            param_specs(cfg, tp_axis=plan.tp_axis, ep_axis=plan.ep_axis),
+            storage,
+            axis_sizes=sizes,
+        )
+    except (KeyError, TypeError):
+        # incomplete spec tree — already reported as missing-spec by the
+        # sharding checker; budget conservatively as fully replicated
+        specs = None
+    def leaf_spec_pairs(storage_sub, specs_sub):
+        leaves = list(iter_leaves(storage_sub))
+        if specs_sub is None:
+            return [(leaf, ()) for _, leaf in leaves]  # replicated fallback
+        return [
+            (leaf, spec)
+            for (_, leaf), (_, spec) in zip(leaves, iter_leaves(specs_sub))
+        ]
+
+    S = max(1, plan.n_stages)
+    tp = mesh.size(plan.tp_axis) if plan.tp_axis else 1
+
+    if plan.is_pipeline:
+        try:
+            counts = stage_layers(cfg.n_layer, S)
+        except ValueError:
+            return  # bad-stage-split already reported; no meaningful budget
+        l_max = max(counts)
+        # blocks: per-device = per-layer bytes * l_max (zero-padded stage
+        # stack, parallel/partition.pad_stage_blocks), tp-sharded per spec
+        blocks_dev = sum(
+            _sharded_nbytes(leaf, spec, sizes) // cfg.n_layer * l_max
+            for leaf, spec in leaf_spec_pairs(
+                storage["blocks"], specs["blocks"] if specs else None
+            )
+        )
+        # embeddings/final norm/head are replicated on every stage
+        head_dev = sum(
+            int(leaf.nbytes)
+            for k, v in storage.items() if k != "blocks"
+            for _, leaf in iter_leaves(v)
+        )
+        params_dev = blocks_dev + head_dev
+        # per-stage rotating KV: (l_max, n_slots, M, G, seq, hs) x2, the
+        # group dim tp-sharded when divisible (PipelineEngine._init_kv)
+        G = cfg.n_query_groups
+        g_denom = tp if (tp > 1 and G % tp == 0) else 1
+        kv_dev = (
+            2 * l_max * (S + 1) * plan.samples_per_slot * (G // g_denom)
+            * plan.cache_len * cfg.head_size * kv_item
+        )
+        act_batch = plan.samples_per_slot
+    else:
+        params_dev = sum(
+            _sharded_nbytes(leaf, spec, sizes)
+            for leaf, spec in leaf_spec_pairs(storage, specs)
+        )
+        if plan.serving is not None:
+            # an invalid pool geometry is already a bad-serving-config
+            # finding; budget it as zero instead of dividing by block_size
+            kv_dev = max(0, (
+                plan.serving.pool_bytes(cfg, plan.seq_len, plan.kv_dtype)
+                if plan.serving.block_size >= 1 else 0
+            ))
+        else:
+            kv_dev = cfg.estimate_kv_bytes(plan.batch, plan.cache_len, plan.kv_dtype)
+        act_batch = plan.batch
+
+    if not plan.donate_kv:
+        kv_dev *= 2  # no donation: XLA ping-pongs two full cache buffers
+
+    # activation high-water mark (rough, per live layer — not cumulative):
+    # residual stream + qkv/attn-out + widest MLP intermediate, plus the
+    # head's logits row.  Decode keeps T=1; prefill passes its bucket width.
+    T = max(1, plan.act_seq_len)
+    mlp_live = (
+        2 * cfg.intermediate_size
+        if cfg.mlp_class_name in ("LLaMAMLP", "GemmaMLP", "LLaMAMoE")
+        else cfg.intermediate_size
+    )
+    act_dev = act_batch * T * (
+        4 * cfg.n_embd + cfg.qkv_size + cfg.attn_out_size + mlp_live
+    ) * par_item + act_batch * cfg.padded_vocab_size * par_item
+
+    total = params_dev + kv_dev + act_dev
+    breakdown["per_device"] = {
+        "params_bytes": int(params_dev),
+        "kv_bytes": int(kv_dev),
+        "act_bytes": int(act_dev),
+        "total_bytes": int(total),
+    }
+    breakdown["n_devices"] = mesh.n_devices
+
+    if plan.hbm_gb is None:
+        return
+    budget = int(plan.hbm_gb * GiB)
+    breakdown["budget_bytes"] = budget
+    breakdown["budget_utilization"] = round(total / budget, 4) if budget else None
+    avail = budget - params_dev - act_dev
+    fits: Dict[str, Any] = {}
+    if plan.serving is not None:
+        per_block = cfg.estimate_kv_bytes(1, plan.serving.block_size, plan.kv_dtype)
+        fits["max_pool_blocks"] = max(0, int(avail // per_block)) if per_block else 0
+    else:
+        if plan.is_pipeline:
+            per_lane = kv_dev // max(1, plan.samples_per_slot)
+            fits["max_samples_per_slot"] = max(0, int(avail // per_lane)) if per_lane else 0
+        else:
+            per_seq = cfg.estimate_kv_bytes(1, plan.cache_len, plan.kv_dtype)
+            per_tok = cfg.estimate_kv_bytes(plan.batch, 1, plan.kv_dtype)
+            fits["max_batch"] = max(0, int(avail // per_seq)) if per_seq else 0
+            fits["max_context"] = max(0, int(avail // per_tok)) if per_tok else 0
+    breakdown["fits"] = fits
+
+    if total > budget:
+        dev = breakdown["per_device"]
+        findings.append(_finding(
+            plan, "hbm-over-budget",
+            f"per-device footprint {total / GiB:.2f} GiB exceeds the "
+            f"{plan.hbm_gb:g} GiB budget (params {dev['params_bytes'] / GiB:.2f}"
+            f" + kv {dev['kv_bytes'] / GiB:.2f} + activations "
+            f"{dev['act_bytes'] / GiB:.2f}); fits: "
+            + (", ".join(f"{k}={v}" for k, v in fits.items()) or "nothing"),
+        ))
+
+
+# ---------------------------------------------------------------------------
+# checker 3: schedule soundness
+# ---------------------------------------------------------------------------
+
+
+def _check_permutation(plan, perm, n, what, findings) -> bool:
+    """Validate `perm` as a full bijection over `n` ranks; returns True when
+    sound.  Aggregates all problems into ONE unmatched-permute finding."""
+    problems: List[str] = []
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    for r in set(srcs) | set(dsts):
+        if not (0 <= r < n):
+            problems.append(f"rank {r} out of range [0, {n})")
+    for r in sorted(set(srcs)):
+        if srcs.count(r) > 1:
+            problems.append(f"rank {r} sends twice in one ppermute")
+    for r in sorted(set(dsts)):
+        if dsts.count(r) > 1:
+            problems.append(f"rank {r} receives two sends")
+    for r in range(n):
+        if r not in srcs:
+            problems.append(f"rank {r} never sends (its neighbor's recv is unmatched)")
+        if r not in dsts:
+            problems.append(f"rank {r} never receives (it decodes garbage/zeros)")
+    if problems:
+        findings.append(_finding(
+            plan, "unmatched-permute",
+            f"{what} ppermute {list(perm)} over {n} ranks is not a "
+            "permutation: " + "; ".join(dict.fromkeys(problems)),
+        ))
+        return False
+    return True
+
+
+def _check_schedule(
+    plan: PlanSpec, findings: List[Finding], breakdown: Dict[str, Any]
+) -> None:
+    S, M = plan.n_stages, plan.samples_per_slot
+
+    if S > 1 or plan.ring_perm is not None:
+        n = max(S, 1)
+        perm = tuple(plan.ring_perm) if plan.ring_perm is not None else ring_permutation(n)
+        if _check_permutation(plan, perm, n, "stage-ring", findings):
+            # symbolic execution: follow stage 0's activation around the
+            # ring — it must visit every stage and return in exactly n hops
+            nxt = dict(perm)
+            rank, orbit = 0, [0]
+            for _ in range(n):
+                rank = nxt[rank]
+                if rank == 0:
+                    break
+                orbit.append(rank)
+            if len(orbit) < n:
+                findings.append(_finding(
+                    plan, "broken-ring",
+                    f"stage-ring ppermute is a bijection but splits into "
+                    f"disjoint cycles (stage 0's orbit is {orbit}, not all "
+                    f"{n} stages): the head never sees stages outside its "
+                    "cycle",
+                ))
+            else:
+                breakdown["ring_rotation_steps"] = n
+
+    if plan.rank_programs:
+        progs = plan.rank_programs
+        ref = progs[0]
+        for r, prog in enumerate(progs[1:], start=1):
+            if prog != ref:
+                step = next(
+                    (i for i, (a, b) in enumerate(zip(ref, prog)) if a != b),
+                    min(len(ref), len(prog)),
+                )
+                findings.append(_finding(
+                    plan, "schedule-divergence",
+                    f"rank {r}'s collective sequence diverges from rank 0 "
+                    f"at step {step}: every rank must issue the identical "
+                    "op sequence per edge or the ring deadlocks",
+                ))
+                break
+
+    if S > 1:
+        lanes = S * M
+        inflight = min(plan.n_samples, lanes)
+        bubble = 1.0 - inflight / lanes if lanes else 1.0
+        breakdown["ring_lanes"] = lanes
+        breakdown["bubble_fraction"] = round(bubble, 4)
+        if plan.n_samples < S:
+            findings.append(_finding(
+                plan, "pipeline-underfill",
+                f"n_samples={plan.n_samples} < n_stages={S}: the recurrent "
+                f"ring idles {bubble:.0%} of its {lanes} lanes (the paper's "
+                "full-utilization invariant is n_samples >= n_stages; "
+                f"{lanes} samples saturate this plan)",
+            ))
+
+
+def _check_stages(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
+    from mdi_llm_tpu.parallel.partition import stage_layers
+
+    if plan.n_stages < 1:
+        findings.append(_finding(
+            plan, "bad-stage-split", f"n_stages={plan.n_stages} must be >= 1"
+        ))
+        return
+    try:
+        counts = stage_layers(plan.cfg.n_layer, plan.n_stages)
+    except ValueError as e:
+        findings.append(_finding(plan, "bad-stage-split", str(e)))
+        return
+    if plan.n_stages > 1:
+        breakdown["stage_layers"] = counts
+
+
+def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
+    sv = plan.serving
+    if sv is None:
+        return
+    problems = []
+    if sv.block_size < 1:
+        problems.append(f"block_size={sv.block_size} must be positive")
+    if sv.max_batch < 1:
+        problems.append(f"max_batch={sv.max_batch} must be positive")
+    n_blocks = sv.num_pool_blocks(plan.seq_len) if sv.block_size >= 1 else 0
+    if sv.block_size >= 1 and n_blocks < 2:
+        problems.append(
+            f"pool of {n_blocks} block(s) cannot serve anything (block 0 is "
+            "the reserved trash block; KVPool needs >= 2)"
+        )
+    for p in problems:
+        findings.append(_finding(plan, "bad-serving-config", p))
+    if sv.block_size >= 1:
+        breakdown["kv_pool"] = {
+            "num_blocks": n_blocks,
+            "block_size": sv.block_size,
+            "pool_bytes": sv.pool_bytes(plan.cfg, plan.seq_len, plan.kv_dtype),
+        }
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def audit_plan(plan: PlanSpec) -> AuditReport:
+    """Run every checker family; never touches a device or compiles."""
+    findings: List[Finding] = []
+    breakdown: Dict[str, Any] = {}
+    _check_mesh(plan, findings)
+    _check_stages(plan, findings, breakdown)
+    _check_sharding(plan, findings)
+    _check_serving(plan, findings, breakdown)
+    _check_schedule(plan, findings, breakdown)
+    _check_memory(plan, findings, breakdown)
+    order = {code: i for i, code in enumerate(AUDIT_RULES)}
+    findings.sort(key=lambda f: (order.get(f.rule, 99), f.message))
+    return AuditReport(plan=plan, findings=findings, breakdown=breakdown)
+
+
+def preflight(
+    cfg: Config,
+    *,
+    n_stages: int = 0,
+    pipeline: Optional[bool] = None,
+    tp: int = 1,
+    samples_per_slot: int = 1,
+    n_samples: Optional[int] = None,
+    batch: int = 1,
+    seq_len: Optional[int] = None,
+    kv_seq_len: Optional[int] = None,
+    act_seq_len: int = 1,
+    dtype: str = "bfloat16",
+    cache_dtype: Optional[str] = None,
+    quantize: Optional[str] = None,
+    serving: Optional[ServingConfig] = None,
+    hbm_gb: Optional[float] = None,
+    origin: str = "<preflight>",
+) -> AuditReport:
+    """Build the PlanSpec an engine launch implies and audit it.  Shared by
+    bench.py / mdi-serve / mdi-starter; pure host-side analysis — adds zero
+    compiles (the CompileGuard counters are untouched by construction)."""
+    S = max(1, int(n_stages or 1))
+    axes: Dict[str, int] = {}
+    if S > 1:
+        axes["pipe"] = S
+    if tp > 1:
+        axes["tp"] = int(tp)
+    plan = PlanSpec(
+        cfg=cfg,
+        mesh=MeshSpec.from_dict(axes),
+        tp_axis="tp" if tp > 1 else None,
+        n_stages=S,
+        pipeline=pipeline,
+        samples_per_slot=max(1, int(samples_per_slot)),
+        n_samples=int(n_samples if n_samples is not None else batch),
+        batch=int(batch),
+        max_seq_length=seq_len,
+        kv_seq_len=kv_seq_len,
+        act_seq_len=act_seq_len,
+        dtype=dtype,
+        cache_dtype=None if cache_dtype in (None, "auto") else cache_dtype,
+        quantize=None if quantize in (None, "none") else quantize,
+        serving=serving,
+        hbm_gb=hbm_gb,
+        # the pipeline ring replicates embeddings/head on every stage
+        shard_head=not (pipeline if pipeline is not None else S > 1),
+        origin=origin,
+    )
+    return audit_plan(plan)
+
+
+def refusal_text(tool: str) -> str:
+    return (f"{tool}: mdi-audit preflight refused the plan "
+            "(re-run with --no-preflight to launch anyway)")
+
+
+def enforce_preflight(
+    report: AuditReport,
+    tool: str,
+    allow: bool = False,
+    emit=None,
+    exit_: bool = True,
+) -> bool:
+    """The shared launch gate for bench.py / mdi-serve / mdi-starter: emit
+    every finding prefixed with `tool`, then refuse on ERROR findings
+    unless `allow` (--no-preflight).  Returns True when the launch may
+    proceed; with ``exit_=False`` a refusal returns False instead of
+    raising SystemExit (mdi-starter ships an abort sentinel through its
+    run-spec broadcast so secondaries exit instead of deadlocking)."""
+    if emit is None:
+        def emit(line):
+            print(line, file=sys.stderr)
+    for line in report.render_findings():
+        emit(f"{tool}: preflight: {line}")
+    if not report.errors or allow:
+        return True
+    if exit_:
+        raise SystemExit(refusal_text(tool))
+    return False
+
+
+def audit_detail(report: AuditReport) -> Dict[str, Any]:
+    """The compact per-row record bench.py stores under `detail.audit`."""
+    dev = report.breakdown.get("per_device", {})
+    return {
+        "findings": len(report.errors),
+        "warnings": len(report.warnings),
+        "est_hbm_bytes": int(dev.get("params_bytes", 0) + dev.get("kv_bytes", 0)),
+        "est_params_bytes": int(dev.get("params_bytes", 0)),
+        "est_kv_bytes": int(dev.get("kv_bytes", 0)),
+        "est_act_bytes": int(dev.get("act_bytes", 0)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mdi-audit",
+        description="Static plan auditor: sharding consistency, per-device "
+        "HBM budgets, and pipeline/collective schedule checks — before the "
+        "first compile (see docs/analysis.md, 'Plan audit')",
+    )
+    src = ap.add_argument_group("plan source")
+    src.add_argument("--model", default=None, help="registry model name")
+    src.add_argument("--config", default=None, metavar="FILE",
+                     help="model_config.yaml / config.json to audit")
+    src.add_argument("--plan", default=None, metavar="FILE",
+                     help="mesh/nodes config JSON (examples/mesh_configs, "
+                     "examples/node_configs schemas)")
+    par = ap.add_argument_group("parallel plan")
+    par.add_argument("--mesh", default=None, metavar="AXES",
+                     help="explicit mesh, e.g. pipe=4,tp=2")
+    par.add_argument("--stages", type=int, default=None,
+                     help="pipeline stages (default: plan file or 1)")
+    par.add_argument("--tp", type=int, default=None,
+                     help="tensor-parallel devices per stage")
+    par.add_argument("--samples-per-slot", type=int, default=None)
+    par.add_argument("--n-samples", type=int, default=None,
+                     help="concurrent samples (ring bubble check)")
+    run = ap.add_argument_group("run shape")
+    run.add_argument("--batch", type=int, default=1)
+    run.add_argument("--seq-len", type=int, default=None)
+    run.add_argument("--prompt-len", type=int, default=1,
+                     help="widest live token axis for the activation term")
+    run.add_argument("--dtype", default="bfloat16",
+                     choices=("bfloat16", "float16", "float32"))
+    run.add_argument("--quantize", default="none",
+                     choices=("none", "int8", "w8a8", "int4"))
+    run.add_argument("--kv-dtype", default="auto")
+    srv = ap.add_argument_group("serving (paged KV pool)")
+    srv.add_argument("--serve", action="store_true",
+                     help="audit a ServingConfig pool instead of a dense cache")
+    srv.add_argument("--block-size", type=int, default=16)
+    srv.add_argument("--max-blocks", type=int, default=None)
+    srv.add_argument("--max-batch", type=int, default=8)
+    srv.add_argument("--prefill-chunk", type=int, default=128)
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM budget (e.g. 16 for v5e)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="grandfather findings via an mdi-lint-style baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print the audit rule registry and exit")
+    return ap
+
+
+def _plan_from_args(args) -> PlanSpec:
+    stages, tp, spslot, n_samples, seq_len = args.stages, args.tp, None, None, args.seq_len
+    plan_file: Dict[str, Any] = {}
+    origin = "<cli>"
+    if args.plan:
+        plan_file = json.loads(Path(args.plan).read_text())
+        origin = str(args.plan)
+        if "nodes" in plan_file:  # reference settings_distr schema
+            n_nodes = 1 + len(plan_file["nodes"].get("secondary") or [])
+            stages = stages if stages is not None else plan_file.get(
+                "pipeline_stages", n_nodes
+            )
+        else:
+            stages = stages if stages is not None else plan_file.get("pipeline_stages")
+        tp = tp if tp is not None else plan_file.get("tp_devices")
+        spslot = plan_file.get("samples_per_slot")
+        n_samples = plan_file.get("n_samples")
+        seq_len = seq_len if seq_len is not None else plan_file.get("sequence_length")
+
+    if args.config:
+        cfg = Config.from_file(args.config)
+    elif args.model:
+        cfg = Config.from_name(args.model)
+    elif plan_file.get("model"):
+        cfg = Config.from_name(plan_file["model"])
+    else:
+        raise ValueError("need --model, --config, or a plan file with a "
+                         "'model' key")
+
+    stages = int(stages or 1)
+    tp = int(tp or 1)
+    spslot = int(args.samples_per_slot if args.samples_per_slot is not None
+                 else (spslot or 1))
+    n_samples = int(args.n_samples if args.n_samples is not None
+                    else (n_samples or args.batch))
+
+    if args.mesh is not None:
+        mesh = MeshSpec.parse(args.mesh)
+    else:
+        axes: Dict[str, int] = {}
+        if stages > 1:
+            axes["pipe"] = stages
+        if tp > 1:
+            axes["tp"] = tp
+        if "mesh" in plan_file:  # training mesh schema (train_dp4_tp2.json)
+            axes = dict(plan_file["mesh"])
+            tp = int(axes.get("tp", tp))
+        mesh = MeshSpec.from_dict(axes)
+
+    serving = None
+    if args.serve:
+        serving = ServingConfig(
+            block_size=args.block_size,
+            max_blocks=args.max_blocks,
+            max_batch=args.max_batch,
+            prefill_chunk=args.prefill_chunk,
+        )
+    return PlanSpec(
+        cfg=cfg,
+        mesh=mesh,
+        tp_axis="tp" if ("tp" in mesh.names or tp > 1) else None,
+        n_stages=stages,
+        samples_per_slot=spslot,
+        n_samples=n_samples,
+        batch=args.batch,
+        max_seq_length=seq_len,
+        act_seq_len=args.prompt_len,
+        dtype=args.dtype,
+        cache_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
+        quantize=None if args.quantize == "none" else args.quantize,
+        serving=serving,
+        hbm_gb=args.hbm_gb,
+        shard_head=stages <= 1,
+        origin=origin,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checks:
+        width = max(len(c) for c in AUDIT_RULES)
+        for code, (sev, summary) in AUDIT_RULES.items():
+            print(f"{code:<{width}}  [{sev}] {summary}")
+        return 0
+    try:
+        plan = _plan_from_args(args)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"mdi-audit: {e}", file=sys.stderr)
+        return 2
+    report = audit_plan(plan)
+
+    errors = report.errors
+    if args.baseline:
+        new, _old = Baseline.load(Path(args.baseline)).split(errors)
+        errors = new
+
+    if args.format == "json":
+        out = report.as_json()
+        out["new_errors"] = len(errors)
+        print(json.dumps(out, indent=2))
+    else:
+        print(report.render_text())
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

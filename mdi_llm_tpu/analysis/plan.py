@@ -1,0 +1,319 @@
+"""Abstract plan IR for `mdi-audit` (the PLAN-level companion to mdi-lint).
+
+A *plan* is everything that determines where bytes live and how collectives
+fire before the first compile: the model `Config`, a mesh declaration
+(axis name → size), the parallel strategy (tp/ep axes, pipeline stages,
+samples per ring slot), and optionally a `ServingConfig` for the paged-KV
+pool.  This module models all of it **symbolically** — abstract shapes are
+zero-stride numpy broadcast views (correct `.shape`/`.dtype`/`.nbytes`,
+zero memory), permutations are plain `(src, dst)` tuples — so the auditor
+(`audit.py`) can evaluate a plan without touching a device, initializing a
+JAX backend, or compiling anything.  That constraint is load-bearing: the
+whole point is to reject a bad plan before the expensive part starts, and
+it is enforced by `tests/test_audit.py` with a backend trip-wire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mdi_llm_tpu.config import Config, ServingConfig, dtype_bytes
+
+__all__ = [
+    "MeshSpec",
+    "PlanSpec",
+    "abstract_params",
+    "iter_leaves",
+    "tree_bytes",
+    "ring_permutation",
+    "resolve_np_dtype",
+]
+
+
+# ---------------------------------------------------------------------------
+# dtypes (no jax: ml_dtypes registers bfloat16/float8 with numpy)
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "auto": "bfloat16",  # engine default when cache_dtype is unset
+    "float8": "float8_e4m3fn",
+    "bf16": "bfloat16",
+    "f16": "float16",
+    "f32": "float32",
+}
+
+
+def resolve_np_dtype(dtype) -> np.dtype:
+    """Name/np-dtype/jax-scalar-type → numpy dtype, backend-free."""
+    import ml_dtypes  # noqa: F401  (registers bfloat16/float8 with numpy)
+
+    if isinstance(dtype, str):
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+        if dtype in ("float8_e4m3fn", "float8_e5m2"):
+            return np.dtype(getattr(ml_dtypes, dtype))
+        if dtype == "bfloat16":
+            return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype)
+
+
+def _stub(shape: Sequence[int], dtype) -> np.ndarray:
+    """Abstract array: right shape/dtype/nbytes, zero actual memory."""
+    return np.broadcast_to(np.zeros((), resolve_np_dtype(dtype)), tuple(shape))
+
+
+# ---------------------------------------------------------------------------
+# mesh + plan declarations
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declared device mesh: ordered {axis name: size}.  Purely symbolic —
+    no devices are enumerated; `n_devices` is what the plan CLAIMS."""
+
+    axes: Tuple[Tuple[str, int], ...] = ()
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
+        return cls(tuple((str(k), int(v)) for k, v in d.items()))
+
+    @classmethod
+    def parse(cls, text: str) -> "MeshSpec":
+        """'pipe=4,tp=2' → MeshSpec.  Empty string → single device."""
+        axes = []
+        for part in filter(None, (p.strip() for p in text.split(","))):
+            m = re.fullmatch(r"([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(-?\d+)", part)
+            if not m:
+                raise ValueError(f"bad mesh axis {part!r} (want name=size)")
+            axes.append((m.group(1), int(m.group(2))))
+        return cls(tuple(axes))
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(k for k, _ in self.axes)
+
+    @property
+    def sizes(self) -> Dict[str, int]:
+        return dict(self.axes)
+
+    def size(self, name: str, default: int = 1) -> int:
+        return self.sizes.get(name, default)
+
+    @property
+    def n_devices(self) -> int:
+        return int(math.prod(v for _, v in self.axes)) if self.axes else 1
+
+    def describe(self) -> str:
+        return ",".join(f"{k}={v}" for k, v in self.axes) or "single-device"
+
+
+@dataclasses.dataclass
+class PlanSpec:
+    """One auditable (Config, mesh, parallel plan, ServingConfig) tuple.
+
+    `kv_seq_len` is the ACTUAL cache length a run will allocate (the
+    engines size caches to the run, `generation._run_cache_len`); when
+    None the budget uses `max_seq_length` — the conservative ceiling.
+    `ring_perm` overrides the derived stage-ring permutation (the IR knob
+    the schedule checker exercises; None → `ring_permutation(n_stages)`).
+    `shard_head` mirrors which engine consumes the plan: the Generator
+    mesh path shards embeddings/head on tp (vocab divisibility matters),
+    the pipeline ring replicates them per stage.
+    """
+
+    cfg: Config
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    tp_axis: Optional[str] = None
+    ep_axis: Optional[str] = None
+    dp_axis: Optional[str] = None
+    sp_axis: Optional[str] = None
+    n_stages: int = 1
+    pipeline: Optional[bool] = None  # None → inferred from n_stages > 1
+    samples_per_slot: int = 1
+    n_samples: int = 1
+    batch: int = 1
+    max_seq_length: Optional[int] = None
+    kv_seq_len: Optional[int] = None
+    act_seq_len: int = 1  # widest live token axis (decode=1, prefill=bucket)
+    dtype: str = "bfloat16"
+    cache_dtype: Optional[str] = None
+    quantize: Optional[str] = None
+    serving: Optional[ServingConfig] = None
+    ring_perm: Optional[Tuple[Tuple[int, int], ...]] = None
+    rank_programs: Optional[List[List[Tuple]]] = None  # per-rank op traces
+    hbm_gb: Optional[float] = None
+    shard_head: bool = True
+    donate_kv: bool = True
+    origin: str = "<plan>"
+
+    @property
+    def is_pipeline(self) -> bool:
+        """True when the plan runs the recurrent ring engine — a 1-stage
+        ring (bench --pipeline 1) still uses slot-based KV, not the dense
+        Generator cache."""
+        return self.n_stages > 1 if self.pipeline is None else bool(self.pipeline)
+
+    @property
+    def seq_len(self) -> int:
+        s = self.max_seq_length or self.cfg.block_size
+        return int(min(s, self.cfg.block_size))
+
+    @property
+    def cache_len(self) -> int:
+        return int(min(self.kv_seq_len or self.seq_len, self.seq_len))
+
+    @property
+    def kv_dtype(self) -> str:
+        return self.cache_dtype or self.dtype
+
+    def describe(self) -> str:
+        bits = [self.cfg.name or "<config>", f"mesh {self.mesh.describe()}"]
+        if self.n_stages > 1:
+            bits.append(f"stages={self.n_stages} M={self.samples_per_slot}")
+        bits.append(f"dtype={self.dtype}")
+        if self.quantize and self.quantize != "none":
+            bits.append(f"quant={self.quantize}")
+        if self.serving is not None:
+            bits.append(f"serve(bs={self.serving.block_size})")
+        return " | ".join(bits)
+
+
+# ---------------------------------------------------------------------------
+# abstract parameter shapes
+# ---------------------------------------------------------------------------
+
+
+def abstract_params(cfg: Config, dtype="bfloat16", quantize: Optional[str] = None):
+    """Pytree of zero-stride stubs mirroring `transformer.init_params`
+    exactly (shapes, dtypes, and key layout), optionally transformed to the
+    quantized storage layout of `ops.quant.quantize_params` so per-leaf
+    `.nbytes` is the true HBM cost.  Costs no memory and no backend."""
+    L, D, V = cfg.n_layer, cfg.n_embd, cfg.padded_vocab_size
+    I = cfg.intermediate_size
+
+    def lin(out_d, in_d, bias=cfg.bias):
+        p = {"weight": _stub((L, out_d, in_d), dtype)}
+        if bias:
+            p["bias"] = _stub((L, out_d), dtype)
+        return p
+
+    def norm_p():
+        p = {"weight": _stub((L, D), dtype)}
+        if cfg.norm_class_name == "LayerNorm" and cfg.bias:
+            p["bias"] = _stub((L, D), dtype)
+        return p
+
+    attn = {"qkv": lin(cfg.qkv_size, D), "proj": lin(D, cfg.attn_out_size)}
+    if cfg.mlp_class_name == "GptNeoxMLP":
+        mlp = {"fc": lin(I, D), "proj": lin(D, I)}
+    elif cfg.mlp_class_name in ("LLaMAMLP", "GemmaMLP"):
+        mlp = {
+            "fc_1": lin(I, D, bias=False),
+            "fc_2": lin(I, D, bias=False),
+            "proj": lin(D, I, bias=False),
+        }
+    else:  # LLaMAMoE
+        E = cfg.n_expert
+        mlp = {
+            "gate": {"weight": _stub((L, E, D), dtype)},
+            "experts": {
+                "fc_1": {"weight": _stub((L, E, I, D), dtype)},
+                "fc_2": {"weight": _stub((L, E, I, D), dtype)},
+                "proj": {"weight": _stub((L, E, D, I), dtype)},
+            },
+        }
+    blocks = {"norm_1": norm_p(), "attn": attn, "mlp": mlp}
+    if not cfg.shared_attention_norm:
+        blocks["norm_2"] = norm_p()
+
+    params: Dict[str, Any] = {
+        "wte": {"weight": _stub((V, D), dtype)},
+        "blocks": blocks,
+        "ln_f": {
+            "weight": _stub((D,), dtype),
+            **(
+                {"bias": _stub((D,), dtype)}
+                if cfg.norm_class_name == "LayerNorm" and cfg.bias
+                else {}
+            ),
+        },
+    }
+    if cfg.pos_embedding == "learned":
+        params["wpe"] = {"weight": _stub((cfg.block_size, D), dtype)}
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"weight": _stub((V, D), dtype)}
+        if cfg.lm_head_bias:
+            params["lm_head"]["bias"] = _stub((V,), dtype)
+    elif cfg.lm_head_bias:
+        params["lm_head"] = {"bias": _stub((V,), dtype)}
+
+    if quantize and quantize != "none":
+        params = _quantize_stubs(params, quantize)
+    return params
+
+
+def _quantize_stubs(params, flag: str):
+    """Apply the `ops.quant.quantize_params` storage transform to a stub
+    tree: every >=2-D "weight" outside SKIP_KEYS becomes int8 storage
+    (+ f32 scale); int4 packs two nibbles per byte with group scales."""
+    from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, SKIP_KEYS, w4_group_size
+
+    mode = FLAG_TO_MODE.get(flag, flag)
+    if mode not in ("w8", "w8a8", "w4"):
+        raise ValueError(f"unknown quantize mode {flag!r}")
+    wkey = {"w8": "weight_q", "w8a8": "weight_q8", "w4": "weight_q4"}[mode]
+
+    def walk(node, name):
+        if not isinstance(node, dict):
+            return node
+        if name in SKIP_KEYS:
+            return node
+        out = {}
+        for k, v in node.items():
+            if k == "weight" and np.ndim(v) >= 2:
+                shape = np.shape(v)
+                if mode == "w4":
+                    in_d = shape[-1]
+                    g = w4_group_size(in_d)
+                    out[wkey] = _stub(shape[:-1] + (in_d // 2,), np.int8)
+                    out["scale"] = _stub(shape[:-1] + (in_d // g,), np.float32)
+                else:
+                    out[wkey] = _stub(shape, np.int8)
+                    out["scale"] = _stub(shape[:-1], np.float32)
+            else:
+                out[k] = walk(v, k)
+        return out
+
+    return walk(params, "")
+
+
+def iter_leaves(tree, prefix: str = "") -> Iterator[Tuple[str, Any]]:
+    """Yield ('blocks.attn.qkv.weight', leaf) pairs in key order."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from iter_leaves(tree[k], f"{prefix}.{k}" if prefix else k)
+    else:
+        yield prefix, tree
+
+
+def tree_bytes(tree) -> int:
+    """Logical bytes of a stub (or real) pytree — `.nbytes` is shape-based,
+    so zero-stride stubs report the true allocation cost."""
+    return sum(int(leaf.nbytes) for _, leaf in iter_leaves(tree))
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+
+def ring_permutation(n: int) -> Tuple[Tuple[int, int], ...]:
+    """The stage/sp ring every engine builds: i → (i+1) mod n.  This is the
+    single source the symbolic schedule checker validates fixtures against
+    (parallel/pipeline.py, ops/ring_attention.py build the same list)."""
+    return tuple((i, (i + 1) % n) for i in range(n))

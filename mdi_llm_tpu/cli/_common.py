@@ -213,6 +213,17 @@ def select_device(args) -> None:
         jax.config.update("jax_platforms", args.device)
 
 
+def resolve_config(args) -> Config:
+    """The architecture alone, WITHOUT loading weights — the mdi-audit
+    preflight runs on this so a refused plan never pays the checkpoint
+    load.  Mirrors `load_model`'s --ckpt/--model resolution order."""
+    if args.ckpt:
+        return Config.from_checkpoint(Path(args.ckpt))
+    if args.model:
+        return Config.from_name(args.model)
+    raise SystemExit("one of --ckpt or --model is required")
+
+
 def load_model(
     args, need_tokenizer: bool = True
 ) -> Tuple[Config, dict, Optional[Tokenizer], Optional[PromptStyle]]:

@@ -62,6 +62,11 @@ def build_parser():
                     help="disable hash-based prefix block reuse")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="engine-wide sampling temperature (0 = greedy)")
+    ap.add_argument("--no-preflight", action="store_true",
+                    help="downgrade a failing mdi-audit preflight to a "
+                    "warning instead of refusing to launch")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM budget for the preflight audit")
     return ap
 
 
@@ -92,6 +97,41 @@ def main(argv=None):
 
     from mdi_llm_tpu.generation import Generator
 
+    # static plan audit BEFORE the checkpoint load (mdi-audit preflight:
+    # pool geometry, divisibility, optional --hbm-gb budget — a refused
+    # plan must not pay the weight load; docs/analysis.md "Plan audit")
+    from mdi_llm_tpu.analysis.audit import enforce_preflight, preflight
+    from mdi_llm_tpu.cli._common import resolve_config
+    from mdi_llm_tpu.config import ServingConfig
+
+    serving_cfg = ServingConfig(
+        block_size=args.block_size,
+        max_blocks=args.max_blocks,
+        max_batch=args.max_batch,
+        prefill_chunk=args.prefill_chunk,
+        prefix_caching=not args.no_prefix_cache,
+        temperature=args.temperature,
+    )
+    report = preflight(
+        resolve_config(args),
+        batch=args.max_batch,
+        seq_len=args.sequence_length,
+        dtype=args.dtype,
+        cache_dtype=args.kv_dtype,
+        quantize=args.quantize,
+        serving=serving_cfg,
+        hbm_gb=args.hbm_gb,
+        origin="mdi-serve",
+    )
+    enforce_preflight(report, "mdi-serve", allow=args.no_preflight)
+    pool = report.breakdown.get("kv_pool", {})
+    if pool:
+        print(
+            f"mdi-serve: KV pool {pool['num_blocks']} blocks x "
+            f"{pool['block_size']} tokens ~= {pool['pool_bytes'] / 2**20:.1f} MiB",
+            file=sys.stderr,
+        )
+
     cfg, params, tokenizer, _style = load_model(
         args, need_tokenizer=not args.synthetic
     )
@@ -102,14 +142,8 @@ def main(argv=None):
         cache_dtype=resolve_kv_dtype(args.kv_dtype) or dtype,
         quantize=args.quantize,
     )
-    engine = gen.serve(
-        block_size=args.block_size,
-        max_blocks=args.max_blocks,
-        max_batch=args.max_batch,
-        prefill_chunk=args.prefill_chunk,
-        prefix_caching=not args.no_prefix_cache,
-        temperature=args.temperature,
-    )
+    # the audited config IS the engine config — no second hand-kept copy
+    engine = gen.serve(serving=serving_cfg)
 
     if args.synthetic:
         trace = synthetic_trace(

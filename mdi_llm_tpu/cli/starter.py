@@ -98,6 +98,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="dispatch the next decode chunk before fetching the previous "
         "one (directly-attached TPUs only; stalls on remote tunnels)",
     )
+    ap.add_argument(
+        "--no-preflight",
+        action="store_true",
+        help="downgrade a failing mdi-audit plan preflight to a warning "
+        "instead of refusing to launch the ring",
+    )
+    ap.add_argument(
+        "--hbm-gb",
+        type=float,
+        default=None,
+        help="per-device HBM budget for the preflight audit",
+    )
     return ap
 
 
@@ -116,8 +128,66 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
     is_starter = process_id == 0
 
     if is_starter:
-        cfg, params, tokenizer, prompt_style = load_model(args, need_tokenizer=True)
+        # CLI beats config file, config beats the default of 1 (same
+        # precedence as the device override, gptserver.py:601-617)
+        eff_tp = (
+            args.tp_devices if args.tp_devices is not None
+            else nodes_cfg.tp_devices
+        )
+        n_stages = (
+            args.pipeline_stages
+            or nodes_cfg.pipeline_stages
+            or jax.device_count() // max(1, eff_tp)
+        )
         raw_prompts = get_user_prompt(args.prompt, args.n_samples)
+
+        # static plan audit BEFORE the checkpoint load and BEFORE committing
+        # the job to this spec: sharding divisibility, stage split, ring-
+        # schedule sanity, the paper's n_samples >= n_stages utilization
+        # invariant (reported with the bubble fraction), optional --hbm-gb
+        # budget.  Pure host analysis over the config alone — refusing here
+        # costs nothing; a bad plan discovered at compile time costs minutes
+        # on a pod (docs/analysis.md, "Plan audit").
+        from mdi_llm_tpu.analysis.audit import (
+            enforce_preflight,
+            preflight,
+            refusal_text,
+        )
+        from mdi_llm_tpu.cli._common import resolve_config
+
+        report = preflight(
+            resolve_config(args),
+            n_stages=n_stages,
+            pipeline=True,
+            tp=max(1, eff_tp),
+            samples_per_slot=args.samples_per_slot,
+            n_samples=len(raw_prompts),
+            batch=len(raw_prompts),
+            seq_len=args.sequence_length,
+            dtype=args.dtype,
+            cache_dtype=args.kv_dtype,
+            quantize=args.quantize,
+            hbm_gb=getattr(args, "hbm_gb", None),
+            origin="mdi-starter",
+        )
+        ok = enforce_preflight(
+            report, "mdi-starter",
+            allow=getattr(args, "no_preflight", False),
+            emit=lambda line: log.warning("%s", line),
+            exit_=False,
+        )
+        if not ok:
+            # a refusal is this feature's EXPECTED outcome, so it must not
+            # strand the secondaries inside their blocking broadcast: ship
+            # an abort sentinel through the same channel so every process
+            # exits cleanly instead of deadlocking the pod
+            msg = refusal_text("mdi-starter") + "\n" + "\n".join(
+                report.render_findings()
+            )
+            broadcast_run_spec({"abort": msg})
+            raise SystemExit(msg)
+
+        cfg, params, tokenizer, prompt_style = load_model(args, need_tokenizer=True)
         if tokenizer is not None:
             styled = [prompt_style.apply(p) for p in raw_prompts]
             prompt_ids = [tokenizer.encode(p).tolist() for p in styled]
@@ -128,12 +198,6 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
                 rng.integers(1, cfg.vocab_size, 8).tolist() for _ in raw_prompts
             ]
             stop_seqs = ()
-        # CLI beats config file, config beats the default of 1 (same
-        # precedence as the device override, gptserver.py:601-617)
-        eff_tp = (
-            args.tp_devices if args.tp_devices is not None
-            else nodes_cfg.tp_devices
-        )
         spec = dict(
             prompt_ids=prompt_ids,
             n_tokens=args.n_tokens,
@@ -146,12 +210,9 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
             quantize=args.quantize,
             kv_dtype=args.kv_dtype,
             seq_len=args.sequence_length,
-            # shape-critical: every process must build the identical SPMD ring
-            n_stages=(
-                args.pipeline_stages
-                or nodes_cfg.pipeline_stages
-                or jax.device_count() // max(1, eff_tp)
-            ),
+            # shape-critical: every process must build the identical SPMD
+            # ring (n_stages/eff_tp computed above, before the preflight)
+            n_stages=n_stages,
             samples_per_slot=args.samples_per_slot,
             rotations_per_call=args.chunk,
             tp=max(1, eff_tp),
@@ -160,6 +221,9 @@ def run_node(args, nodes_cfg: NodesConfig, process_id: int):
         spec = broadcast_run_spec(spec)
     else:
         spec = broadcast_run_spec(None)
+        if "abort" in spec:
+            log.warning("starter aborted the job: %s", spec["abort"])
+            raise SystemExit(1)
         # weights load AFTER the spec so random-init mode (--model, no
         # --ckpt) uses the starter's seed/dtype, not this node's defaults
         args.seed, args.dtype = spec["seed"], spec["dtype"]

@@ -29,6 +29,7 @@ __all__ = [
     "name_to_config",
     "configs",
     "find_multiple",
+    "dtype_bytes",
     # generation defaults (parity with reference src/sub/config.py:47-52)
     "TOP_K",
     "TEMPERATURE",
@@ -48,6 +49,33 @@ def find_multiple(n: int, k: int) -> int:
     if n % k == 0:
         return n
     return n + k - (n % k)
+
+
+# Itemsize table for the dtypes this stack actually stores.  Kept as a plain
+# dict (no numpy/jax import) so memory estimation (`estimate_kv_bytes`,
+# `ServingConfig.pool_bytes`, analysis/plan.py) stays backend-free.
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8,
+    "float32": 4, "int32": 4, "f32": 4,
+    "float16": 2, "bfloat16": 2, "f16": 2, "bf16": 2,
+    "float8": 1, "float8_e4m3fn": 1, "float8_e5m2": 1, "int8": 1, "uint8": 1,
+}
+
+
+def dtype_bytes(dtype) -> int:
+    """Bytes per element for a dtype given as a string name, a numpy dtype,
+    or a jax/numpy scalar type — resolved without importing numpy or jax."""
+    if not isinstance(dtype, str):
+        itemsize = getattr(dtype, "itemsize", None)
+        if isinstance(itemsize, int) and itemsize > 0:
+            return itemsize  # np.dtype instances
+        dtype = getattr(dtype, "__name__", None) or getattr(
+            dtype, "name", str(dtype)
+        )  # scalar types (np.float32, jnp.bfloat16=ml_dtypes.bfloat16)
+    key = str(dtype).lower()
+    if key not in _DTYPE_BYTES:
+        raise ValueError(f"unknown dtype {dtype!r} (known: {sorted(_DTYPE_BYTES)})")
+    return _DTYPE_BYTES[key]
 
 
 @dataclass
@@ -157,6 +185,16 @@ class Config:
             mlp = 2 * D * I + (I + D if self.bias else 0)
         norms = 2 * D * (2 if self.bias and self.norm_class_name == "LayerNorm" else 1)
         return emb + head + L * (attn + mlp + norms) + D
+
+    def estimate_kv_bytes(
+        self, batch: int, seq: int, dtype="bfloat16", n_layer: Optional[int] = None
+    ) -> int:
+        """HBM bytes of a dense KV cache for `batch` sequences of length
+        `seq`: k + v, each (L, B, G, S, hs) — `transformer.init_kv_cache`.
+        Pass `n_layer` for a pipeline stage's slice."""
+        L = self.n_layer if n_layer is None else n_layer
+        per = L * batch * self.n_query_groups * seq * self.head_size
+        return 2 * per * dtype_bytes(dtype)
 
     # ---- constructors ------------------------------------------------------
 
@@ -418,6 +456,25 @@ class ServingConfig:
     # attention backend: None → auto (Pallas kernel on TPU decode steps,
     # exact lax gather fallback elsewhere — tier-1 CPU tests use the latter)
     use_kernel: Optional[bool] = None
+
+    def num_pool_blocks(self, max_seq_length: int) -> int:
+        """Pool size in blocks: `max_blocks` when set, else full coverage
+        (1 trash block + max_batch × ceil(max_seq_length / block_size)) —
+        the same default `serving.engine.ServingEngine` computes."""
+        if self.max_blocks is not None:
+            return int(self.max_blocks)
+        per_seq = -(-int(max_seq_length) // self.block_size)
+        return 1 + self.max_batch * per_seq
+
+    def pool_bytes(
+        self, cfg: "Config", max_seq_length: Optional[int] = None, dtype="bfloat16"
+    ) -> int:
+        """HBM bytes of the paged KV pool for model `cfg`: k + v, each
+        (L, num_blocks, block_size, G, hs) — `transformer.init_paged_kv_cache`.
+        Used by the mdi-audit memory checker and the bench/serve logs."""
+        max_seq = int(min(max_seq_length or cfg.block_size, cfg.block_size))
+        n_blocks = self.num_pool_blocks(max_seq)
+        return cfg.estimate_kv_bytes(1, n_blocks * self.block_size, dtype)
 
 
 def _yaml_scalar(v: Any) -> str:

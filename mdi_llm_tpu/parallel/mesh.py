@@ -24,17 +24,30 @@ def make_mesh(
     the device count used; pass -1 for one axis to infer it."""
     devices = list(devices if devices is not None else jax.devices())
     sizes = dict(axes)
+    for name, v in sizes.items():
+        if not isinstance(v, int) or (v < 1 and v != -1):
+            raise ValueError(
+                f"mesh axis {name!r} must have size >= 1 (or -1 to infer), "
+                f"got {v!r}"
+            )
     infer = [k for k, v in sizes.items() if v == -1]
     if len(infer) > 1:
-        raise ValueError("only one axis size may be -1")
+        raise ValueError(f"only one axis size may be -1, got {infer}")
     known = int(np.prod([v for v in sizes.values() if v != -1]))
     if infer:
-        if len(devices) % known:
-            raise ValueError(f"{len(devices)} devices not divisible by {known}")
+        if known > len(devices) or len(devices) % known:
+            explicit = {k: v for k, v in sizes.items() if v != -1}
+            raise ValueError(
+                f"cannot infer axis {infer[0]!r}: explicit sizes {explicit} "
+                f"multiply to {known}, which does not divide the "
+                f"{len(devices)} available devices"
+            )
         sizes[infer[0]] = len(devices) // known
     total = int(np.prod(list(sizes.values())))
     if total > len(devices):
-        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, have {len(devices)}"
+        )
     grid = np.asarray(devices[:total]).reshape(*sizes.values())
     return Mesh(grid, tuple(sizes.keys()))
 

@@ -69,26 +69,39 @@ def stage_layers(
     gets ≥ 1 layer.
     """
     if n_stages < 1:
-        raise ValueError("n_stages must be >= 1")
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
     if n_layer < n_stages:
-        raise ValueError(f"cannot split {n_layer} layers over {n_stages} stages")
+        raise ValueError(
+            f"cannot split {n_layer} layers over {n_stages} stages: every "
+            f"stage needs >= 1 transformer block — use n_stages <= {n_layer} "
+            "(--pipeline-stages) or a deeper model"
+        )
     ref = _REFERENCE_TABLE.get(n_stages, {}).get(n_layer)
     if ref is not None:
-        return list(ref)
-    if n_stages == 1:
-        return [n_layer]
-    # weighted balanced split: stage 0 weight = starter_fraction, others 1.0
-    weights = [starter_fraction] + [1.0] * (n_stages - 1)
-    total_w = sum(weights)
-    counts = [max(1, int(n_layer * w / total_w)) for w in weights]
-    # distribute the remainder to the non-starter stages, last first
-    i = n_stages - 1
-    while sum(counts) < n_layer:
-        counts[i] += 1
-        i = n_stages - 1 if i <= 1 else i - 1
-    while sum(counts) > n_layer:
-        j = max(range(n_stages), key=lambda s: (counts[s], s))
-        counts[j] -= 1
+        counts = list(ref)
+    elif n_stages == 1:
+        counts = [n_layer]
+    else:
+        # weighted balanced split: stage 0 weight = starter_fraction, others 1.0
+        weights = [starter_fraction] + [1.0] * (n_stages - 1)
+        total_w = sum(weights)
+        counts = [max(1, int(n_layer * w / total_w)) for w in weights]
+        # distribute the remainder to the non-starter stages, last first
+        i = n_stages - 1
+        while sum(counts) < n_layer:
+            counts[i] += 1
+            i = n_stages - 1 if i <= 1 else i - 1
+        while sum(counts) > n_layer:
+            j = max(range(n_stages), key=lambda s: (counts[s], s))
+            counts[j] -= 1
+    # an empty stage would surface much later as a shape error inside the
+    # jitted pipeline step — reject it here with the plan that produced it
+    if len(counts) != n_stages or sum(counts) != n_layer or min(counts) < 1:
+        raise ValueError(
+            f"stage split {counts} is invalid for n_layer={n_layer}, "
+            f"n_stages={n_stages}: every stage must own >= 1 layer and the "
+            f"counts must sum to n_layer"
+        )
     return counts
 
 
@@ -111,6 +124,10 @@ def split_params(
     Stage 0: embeddings + its block slice + final norm + LM head (≡ reference
     `StarterNode`, submodels.py:132-220); other stages: block slice only
     (≡ `SecondaryNode`).  Pure slicing — weights stay in the stacked layout.
+
+    Raises ValueError (via `stage_layers`) for n_stages > n_layer or any
+    plan yielding an empty stage, instead of letting the pipeline step fail
+    later with an opaque shape error.
     """
     bounds = stage_bounds(cfg.n_layer, n_stages, **kw)
     stages: List[Params] = []

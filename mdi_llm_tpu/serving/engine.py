@@ -115,10 +115,10 @@ class ServingEngine:
         self.max_seq_length = gen.max_seq_length
         # blocks per sequence table: full coverage of the engine window
         self.max_blocks_per_seq = -(-self.max_seq_length // bs)
-        num_blocks = serving.max_blocks
-        if num_blocks is None:
-            # every slot can grow to the full window, plus the trash block
-            num_blocks = 1 + serving.max_batch * self.max_blocks_per_seq
+        # pool size: ServingConfig owns the formula (max_blocks, or every
+        # slot grown to the full window plus the trash block) so the
+        # mdi-audit memory checker budgets exactly what gets allocated
+        num_blocks = serving.num_pool_blocks(self.max_seq_length)
         self.pool = KVPool(num_blocks, bs, prefix_caching=serving.prefix_caching)
         self.scheduler = Scheduler(
             self.pool, serving.max_batch, serving.prefill_chunk,

@@ -1,0 +1,574 @@
+"""mdi-audit: static plan auditing — fixture pairs (one bad plan per checker
+family, each producing exactly one finding with the expected code, plus a
+good-plan zero-findings pass), registry-wide spec-coverage and self-check
+properties, memory-estimate sanity against live arrays, the no-JAX-backend
+guarantee (backend trip-wire), the CLI surface, and the mesh/partition
+validation satellites.  This file is the tier-1 CI gate mdi-audit ships as,
+mirroring tests/test_lint.py.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.analysis.audit import (
+    AUDIT_RULES,
+    audit_detail,
+    audit_plan,
+    main as audit_main,
+    preflight,
+)
+from mdi_llm_tpu.analysis.core import Baseline
+from mdi_llm_tpu.analysis.plan import (
+    MeshSpec,
+    PlanSpec,
+    abstract_params,
+    iter_leaves,
+    ring_permutation,
+    tree_bytes,
+)
+from mdi_llm_tpu.config import Config, ServingConfig, dtype_bytes, name_to_config
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def tiny():
+    return Config.from_name("pythia-14m")
+
+
+def codes(report):
+    return [f.rule for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# fixture pairs: one bad plan per checker, exactly one expected finding
+# ---------------------------------------------------------------------------
+
+
+def test_good_default_plan_is_clean():
+    report = audit_plan(PlanSpec(cfg=Config.from_name("tiny-llama-1.1b")))
+    assert report.findings == []
+
+
+def test_good_pipeline_tp_serving_plan_is_clean():
+    cfg = Config.from_name("tiny-llama-1.1b")
+    r = preflight(cfg, n_stages=4, tp=2, n_samples=8, samples_per_slot=2,
+                  seq_len=2048, hbm_gb=16)
+    assert r.findings == []
+    assert r.breakdown["stage_layers"] == [4, 6, 6, 6]
+    assert r.breakdown["bubble_fraction"] == 0.0
+    r2 = audit_plan(PlanSpec(
+        cfg=cfg, serving=ServingConfig(max_batch=8), hbm_gb=16,
+    ))
+    assert r2.findings == []
+
+
+def test_bad_plan_unknown_mesh_axis():
+    cfg = tiny()
+    r = audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"data": 8}), tp_axis="tp",
+    ))
+    assert codes(r) == ["unknown-mesh-axis"]
+    assert "silently replicate" in r.findings[0].message
+
+
+def test_bad_mesh_axis_size_is_an_error_not_green(capsys):
+    """A 0/negative axis size must not audit clean (every divisibility
+    check is vacuous at size <= 1 — the runtime's make_mesh rejects it)."""
+    for mesh in ("tp=0", "tp=-2", "pipe=4,tp=-1"):
+        rc = audit_main(["--model", "tiny-llama-1.1b", "--mesh", mesh])
+        out = capsys.readouterr().out
+        assert rc == 1 and "bad-mesh-axis" in out, (mesh, out)
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), mesh=MeshSpec.from_dict({"tp": 0}), tp_axis="tp",
+    ))
+    assert "bad-mesh-axis" in codes(r)
+
+
+def test_bad_plan_non_divisible_sharded_dim():
+    cfg = Config.from_name("tiny-llama-1.1b")  # n_head=32, G=4, I=5632
+    r = audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"tp": 3}), tp_axis="tp",
+    ))
+    assert codes(r) == ["indivisible-dim"]
+    assert "'tp' (size 3)" in r.findings[0].message
+    # semantic head-count divisibility fires even when every fused leaf dim
+    # happens to divide (G=4 cannot split 8 ways; qkv rows 2560 % 8 == 0)
+    r = audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"tp": 8}), tp_axis="tp",
+    ))
+    assert codes(r) == ["indivisible-dim"]
+    assert "n_query_groups=4" in r.findings[0].message
+
+
+def test_bad_plan_over_budget_kv_pool():
+    cfg = Config.from_name("tiny-llama-1.1b")
+    r = audit_plan(PlanSpec(
+        cfg=cfg, serving=ServingConfig(block_size=16, max_batch=64),
+        hbm_gb=0.5,
+    ))
+    assert codes(r) == ["hbm-over-budget"]
+    assert "exceeds the 0.5 GiB budget" in r.findings[0].message
+    assert "max_pool_blocks" in r.breakdown["fits"]
+
+
+def test_bad_plan_unmatched_ring_permute():
+    cfg = tiny()  # 6 layers: a 4-stage split is valid
+    perm = tuple((i, (i + 1) % 4) for i in range(3))  # stage 3 never sends
+    r = audit_plan(PlanSpec(cfg=cfg, n_stages=4, n_samples=8, ring_perm=perm))
+    assert codes(r) == ["unmatched-permute"]
+    msg = r.findings[0].message
+    assert "rank 3 never sends" in msg and "rank 0 never receives" in msg
+
+
+# ---------------------------------------------------------------------------
+# additional checker coverage
+# ---------------------------------------------------------------------------
+
+
+def test_broken_ring_two_cycles():
+    # bijection, but two disjoint 2-cycles: stage 0's orbit never reaches 2/3
+    perm = ((0, 1), (1, 0), (2, 3), (3, 2))
+    r = audit_plan(PlanSpec(cfg=tiny(), n_stages=4, n_samples=8, ring_perm=perm))
+    assert codes(r) == ["broken-ring"]
+
+
+def test_schedule_divergence_across_ranks():
+    ring = [("ppermute", "pipe", ring_permutation(2))] * 4
+    diverged = list(ring)
+    diverged[2] = ("psum", "pipe", None)
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), n_stages=2, n_samples=4, rank_programs=[ring, diverged],
+    ))
+    assert codes(r) == ["schedule-divergence"]
+    assert "step 2" in r.findings[0].message
+
+
+def test_pipeline_underfill_is_a_warning_with_bubble_fraction():
+    r = preflight(tiny(), n_stages=4, n_samples=2)
+    assert codes(r) == ["pipeline-underfill"]
+    assert r.errors == [] and len(r.warnings) == 1
+    assert r.breakdown["bubble_fraction"] == 0.5
+    assert "50%" in r.warnings[0].message
+
+
+def test_bad_stage_split_rejected():
+    r = preflight(tiny(), n_stages=7, n_samples=8)  # 6 layers over 7 stages
+    assert codes(r) == ["bad-stage-split"]
+    assert "n_stages <= 6" in r.findings[0].message
+
+
+def test_duplicate_axis_use_rejected(monkeypatch):
+    from jax.sharding import PartitionSpec as P
+
+    import mdi_llm_tpu.parallel.sharding as sharding
+
+    real = sharding.param_specs
+
+    def doubled(cfg, tp_axis="tp", ep_axis=None):
+        specs = real(cfg, tp_axis, ep_axis)
+        specs["blocks"]["attn"]["qkv"]["weight"] = P(None, tp_axis, tp_axis)
+        return specs
+
+    monkeypatch.setattr(sharding, "param_specs", doubled)
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), mesh=MeshSpec.from_dict({"tp": 2}), tp_axis="tp",
+    ))
+    assert "duplicate-axis" in codes(r)
+
+
+def test_missing_spec_is_an_error_not_silent_replication(monkeypatch):
+    import mdi_llm_tpu.parallel.sharding as sharding
+
+    real = sharding.param_specs
+
+    def dropped(cfg, tp_axis="tp", ep_axis=None):
+        specs = real(cfg, tp_axis, ep_axis)
+        del specs["ln_f"]
+        return specs
+
+    monkeypatch.setattr(sharding, "param_specs", dropped)
+    r = audit_plan(PlanSpec(cfg=tiny()))
+    assert set(codes(r)) == {"missing-spec"}
+    assert any("ln_f.weight" in f.message for f in r.findings)
+
+
+def test_bad_serving_config_rejected():
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), serving=ServingConfig(block_size=16, max_blocks=1),
+    ))
+    assert codes(r) == ["bad-serving-config"]
+    # a zero/negative block width must yield the finding, not a crash in
+    # the memory checker's pool_bytes call
+    r = audit_plan(PlanSpec(cfg=tiny(), serving=ServingConfig(block_size=0)))
+    assert "bad-serving-config" in codes(r)
+    assert r.breakdown["per_device"]["kv_bytes"] == 0
+
+
+def test_findings_reuse_lint_baseline_machinery():
+    cfg = Config.from_name("tiny-llama-1.1b")
+    plan = PlanSpec(cfg=cfg, mesh=MeshSpec.from_dict({"tp": 3}), tp_axis="tp")
+    findings = audit_plan(plan).findings
+    b = Baseline.from_findings(findings)
+    new, old = b.split(audit_plan(plan).findings)
+    assert new == [] and len(old) == 1  # grandfathered, like mdi-lint
+
+
+# ---------------------------------------------------------------------------
+# registry-wide properties
+# ---------------------------------------------------------------------------
+
+
+def test_param_specs_coverage_is_total_for_every_registry_config():
+    """Every params leaf of every registry family must have a PartitionSpec
+    — catches future model-surgery leaves silently falling back to full
+    replication.  Abstract shapes make this free (no arrays, no backend)."""
+    from mdi_llm_tpu.parallel.sharding import param_specs
+
+    for name in name_to_config:
+        cfg = Config.from_name(name)
+        specs = param_specs(cfg, "tp")
+        shape_paths = {p for p, _ in iter_leaves(abstract_params(cfg))}
+        spec_paths = {p for p, _ in iter_leaves(specs)}
+        assert shape_paths <= spec_paths, (
+            f"{name}: leaves without specs: {sorted(shape_paths - spec_paths)}"
+        )
+
+
+def test_every_registry_config_audits_clean_under_default_plan():
+    for name in name_to_config:
+        report = audit_plan(PlanSpec(cfg=Config.from_name(name)))
+        assert report.findings == [], (
+            f"{name}: " + "; ".join(report.render_findings())
+        )
+
+
+EXAMPLE_PLANS = sorted(
+    list((REPO / "examples" / "mesh_configs").glob("*.json"))
+    + list((REPO / "examples" / "node_configs").glob("*.json"))
+)
+
+
+@pytest.mark.parametrize("plan_file", EXAMPLE_PLANS, ids=lambda p: p.name)
+def test_shipped_example_plans_audit_clean(plan_file, capsys):
+    """Every shipped example topology passes `mdi-audit` with zero ERROR
+    findings against a registry model deep enough for its stage count."""
+    rc = audit_main(["--model", "tiny-llama-1.1b", "--plan", str(plan_file)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+# ---------------------------------------------------------------------------
+# the no-backend guarantee
+# ---------------------------------------------------------------------------
+
+
+def test_audit_never_touches_a_jax_backend(monkeypatch):
+    """The whole point: a plan is auditable before any device exists.  Trip-
+    wire every backend/device/compile entry point and run the full checker
+    stack (sharding + memory + schedule + serving, quantized, budgeted)."""
+    import jax
+    from jax._src import xla_bridge
+
+    def boom(*a, **k):
+        raise AssertionError("mdi-audit touched the JAX backend")
+
+    monkeypatch.setattr(xla_bridge, "backends", boom)
+    monkeypatch.setattr(xla_bridge, "get_backend", boom)
+    monkeypatch.setattr(jax, "devices", boom)
+    monkeypatch.setattr(jax, "local_devices", boom)
+    monkeypatch.setattr(jax, "jit", boom)
+
+    cfg = Config.from_name("tiny-llama-1.1b")
+    r = preflight(cfg, n_stages=4, tp=2, n_samples=8, seq_len=2048,
+                  quantize="int8", hbm_gb=16)
+    assert r.findings == []
+    r = audit_plan(PlanSpec(
+        cfg=cfg, serving=ServingConfig(max_batch=8), hbm_gb=16,
+        quantize="int4",
+    ))
+    assert r.findings == []
+    # bad plans too (every finding path must stay backend-free)
+    assert codes(audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"tp": 3}), tp_axis="tp",
+    ))) == ["indivisible-dim"]
+
+
+# ---------------------------------------------------------------------------
+# memory estimates vs live arrays
+# ---------------------------------------------------------------------------
+
+
+def test_est_hbm_bytes_matches_live_arrays_within_15_percent():
+    """Acceptance bound: params+KV estimate within 15% of the runtime's
+    live-array total for a bench-style decode row (it is exact by
+    construction — the stub tree mirrors init_params leaf for leaf)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mdi_llm_tpu.generation import _bucket, _run_cache_len
+    from mdi_llm_tpu.models import transformer
+
+    cfg = tiny()
+    B, prompt_len, new = 2, 8, 4
+    seq_len = 64
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    Tb = min(_bucket(prompt_len), seq_len)
+    cache_len = _run_cache_len(seq_len, prompt_len + new, Tb)
+    kv = transformer.init_kv_cache(cfg, B, cache_len, dtype=jnp.bfloat16)
+    live = sum(int(x.nbytes) for x in jax.tree_util.tree_leaves((params, kv)))
+
+    report = preflight(cfg, batch=B, seq_len=seq_len, kv_seq_len=cache_len,
+                       dtype="bfloat16")
+    est = audit_detail(report)["est_hbm_bytes"]
+    assert abs(est - live) / live < 0.15, (est, live)
+    assert est == live  # and in fact exact for the dense bf16 layout
+
+
+def test_quantized_storage_estimate_matches_quantize_params():
+    import jax
+    import jax.numpy as jnp
+
+    from mdi_llm_tpu.models import transformer
+    from mdi_llm_tpu.ops.quant import quantize_params
+
+    cfg = tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    for flag in ("int8", "int4"):
+        real = quantize_params(
+            jax.tree_util.tree_map(np.asarray, params),
+            mode={"int8": "w8", "int4": "w4"}[flag],
+        )
+        live = sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(real))
+        est = tree_bytes(abstract_params(cfg, "bfloat16", flag))
+        assert est == live, (flag, est, live)
+
+
+def test_estimate_kv_bytes_hand_computed_two_registry_models():
+    # tiny-llama-1.1b: L=22, G=4, hs=64
+    cfg = Config.from_name("tiny-llama-1.1b")
+    assert (cfg.n_layer, cfg.n_query_groups, cfg.head_size) == (22, 4, 64)
+    assert cfg.estimate_kv_bytes(2, 128, "bfloat16") == 2 * 22 * 2 * 4 * 128 * 64 * 2
+    # pythia-70m: L=6, H=G=8, hs=64
+    cfg = Config.from_name("pythia-70m")
+    assert (cfg.n_layer, cfg.n_query_groups, cfg.head_size) == (6, 8, 64)
+    assert cfg.estimate_kv_bytes(4, 256, "float32") == 2 * 6 * 4 * 8 * 256 * 64 * 4
+
+
+def test_pool_bytes_hand_computed():
+    cfg = Config.from_name("tiny-llama-1.1b")  # block_size (context) = 2048
+    sv = ServingConfig(block_size=16, max_batch=8)
+    # full coverage: 1 trash + 8 * (2048/16) = 1025 blocks
+    assert sv.num_pool_blocks(2048) == 1025
+    assert sv.pool_bytes(cfg, 2048, "bfloat16") == 2 * 22 * 1025 * 16 * 4 * 64 * 2
+    assert ServingConfig(max_blocks=64).num_pool_blocks(2048) == 64
+
+
+def test_dtype_bytes_accepts_names_and_dtypes():
+    import jax.numpy as jnp
+
+    assert dtype_bytes("bfloat16") == 2
+    assert dtype_bytes("float32") == 4
+    assert dtype_bytes(np.dtype("float16")) == 2
+    assert dtype_bytes(np.float32) == 4
+    assert dtype_bytes(jnp.bfloat16) == 2
+    with pytest.raises(ValueError):
+        dtype_bytes("no-such-dtype")
+
+
+# ---------------------------------------------------------------------------
+# satellites: mesh + partition validation
+# ---------------------------------------------------------------------------
+
+
+def test_make_mesh_names_offending_axis(devices):
+    from mdi_llm_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match=r"axis 'tp' must have size >= 1"):
+        make_mesh({"tp": 0, "dp": 2}, devices)
+    with pytest.raises(ValueError, match=r"cannot infer axis 'dp'"):
+        make_mesh({"tp": 3, "dp": -1}, devices)  # 8 devices % 3 != 0
+    with pytest.raises(ValueError, match="only one axis size may be -1"):
+        make_mesh({"tp": -1, "dp": -1}, devices)
+    with pytest.raises(ValueError, match="needs 16 devices, have 8"):
+        make_mesh({"pipe": 16}, devices)
+    # valid inference still works and yields an integer >= 1
+    m = make_mesh({"dp": -1, "tp": 2}, devices)
+    assert dict(m.shape) == {"dp": 4, "tp": 2}
+
+
+def test_stage_layers_rejects_oversplit_and_empty_stages():
+    from mdi_llm_tpu.parallel.partition import stage_layers
+
+    with pytest.raises(ValueError, match="n_stages <= 6"):
+        stage_layers(6, 7)
+    with pytest.raises(ValueError, match="n_stages must be >= 1"):
+        stage_layers(6, 0)
+    # every valid split sums to n_layer with no empty stage
+    for n_layer in (5, 6, 7, 9, 12, 22, 24, 32, 48):
+        for n_stages in range(1, min(n_layer, 9) + 1):
+            counts = stage_layers(n_layer, n_stages)
+            assert sum(counts) == n_layer and min(counts) >= 1
+
+
+def test_split_params_rejects_oversplit_with_actionable_message():
+    from mdi_llm_tpu.models import transformer
+    from mdi_llm_tpu.parallel.partition import split_params
+
+    cfg = tiny()  # 6 layers
+    import jax
+
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="every stage needs >= 1"):
+        split_params(cfg, params, 7)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_clean_plan_exits_zero(capsys):
+    assert audit_main(["--model", "tiny-llama-1.1b", "--stages", "4",
+                       "--n-samples", "8", "--hbm-gb", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "findings: none" in out and "stage layers" in out
+
+
+def test_cli_bad_plan_exits_one(capsys):
+    assert audit_main(["--model", "tiny-llama-1.1b", "--tp", "3"]) == 1
+    assert "indivisible-dim" in capsys.readouterr().out
+
+
+def test_cli_usage_errors_exit_two(capsys):
+    assert audit_main([]) == 2  # no model source
+    assert audit_main(["--model", "no-such-model"]) == 2
+
+
+def test_cli_json_format(capsys):
+    rc = audit_main(["--model", "tiny-llama-1.1b", "--serve", "--hbm-gb",
+                     "16", "--format", "json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["errors"] == 0
+    assert data["breakdown"]["per_device"]["kv_bytes"] > 0
+    assert data["breakdown"]["kv_pool"]["num_blocks"] > 1
+
+
+def test_cli_list_checks(capsys):
+    assert audit_main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    for code in AUDIT_RULES:
+        assert code in out
+
+
+def test_cli_warning_does_not_fail(capsys):
+    # underfilled ring: reported, but exit 0 (launch-blocking is preflight's
+    # job only for ERROR findings)
+    rc = audit_main(["--model", "tiny-llama-1.1b", "--stages", "4",
+                     "--n-samples", "1"])
+    assert rc == 0
+    assert "pipeline-underfill" in capsys.readouterr().out
+
+
+def test_cli_samples_per_slot_overrides_plan_file(tmp_path, capsys):
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps(
+        {"pipeline_stages": 2, "samples_per_slot": 4, "n_samples": 8}
+    ))
+    rc = audit_main(["--model", "tiny-llama-1.1b", "--plan", str(plan),
+                     "--samples-per-slot", "1", "--format", "json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["breakdown"]["ring_lanes"] == 2  # M=1 won, not the file's 4
+
+
+def test_module_entrypoint_dispatches_audit():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mdi_llm_tpu.analysis", "audit", "--list-checks"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0 and "unmatched-permute" in proc.stdout
+    # bare invocation still lints
+    proc = subprocess.run(
+        [sys.executable, "-m", "mdi_llm_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0 and "static-float-arg" in proc.stdout
+
+
+def test_pyproject_registers_console_script():
+    txt = (REPO / "pyproject.toml").read_text()
+    assert 'mdi-audit = "mdi_llm_tpu.analysis.audit:main"' in txt
+
+
+# ---------------------------------------------------------------------------
+# preflight integration (bench / serve / starter)
+# ---------------------------------------------------------------------------
+
+
+def _bench_args(*argv):
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    args = bench.build_parser().parse_args(["--direct", *argv])
+    if args.chunk is None:
+        args.chunk = 16 if args.pipeline else 256
+    return bench, args
+
+
+def test_bench_preflight_records_audit_and_refuses_bad_plan(capsys):
+    bench, args = _bench_args("--model", "tiny-llama-1.1b", "--batch", "2",
+                              "--prompt-len", "8", "--new-tokens", "4",
+                              "--seq-len", "64")
+    from mdi_llm_tpu.config import Config
+
+    cfg = Config.from_name(args.model)
+    detail = bench.run_preflight(args, cfg, "decode")
+    assert detail["findings"] == 0 and detail["est_hbm_bytes"] > 0
+
+    # an over-budget plan refuses...
+    args.hbm_gb = 0.001
+    with pytest.raises(SystemExit, match="preflight refused"):
+        bench.run_preflight(args, cfg, "decode")
+    # ...unless --no-preflight downgrades it to a warning
+    args.no_preflight = True
+    detail = bench.run_preflight(args, cfg, "decode")
+    assert detail["findings"] == 1
+
+
+def test_starter_preflight_refuses_bad_plan_via_abort_sentinel(tmp_path):
+    """A refusal must exit cleanly through the run-spec channel (the same
+    broadcast the secondaries block on), not strand the job."""
+    from mdi_llm_tpu.cli.starter import main as starter_main
+
+    cfg_p = tmp_path / "standalone.json"
+    cfg_p.write_text(json.dumps({"nodes": {"starter": {
+        "addr": "127.0.0.1", "communication": {"port": 1}}, "secondary": []}}))
+    argv = ["--model", "pythia-14m", "--device", "cpu", "--nodes-config",
+            str(cfg_p), "--pipeline-stages", "7", "--n-tokens", "4",
+            "--n-samples", "8"]  # 6 layers over 7 stages: bad-stage-split
+    with pytest.raises(SystemExit, match="preflight refused"):
+        starter_main(argv)
+    # --no-preflight downgrades; the launch then proceeds past the audit
+    # (and on this jax build fails later in shard_map, like the seed does)
+    with pytest.raises((SystemExit, ValueError, AttributeError)) as ei:
+        starter_main(argv + ["--no-preflight"])
+    assert "preflight" not in str(ei.value)
+
+
+def test_serve_cli_preflight_refuses_over_budget_pool(tmp_path, capsys):
+    from mdi_llm_tpu.cli.serve import main as serve_main
+
+    argv = ["--model", "pythia-14m", "--synthetic", "2", "--n-tokens", "4",
+            "--sequence-length", "64", "--max-batch", "2", "--device", "cpu",
+            "--hbm-gb", "0.0001"]
+    with pytest.raises(SystemExit, match="preflight refused"):
+        serve_main(argv)
+    assert "hbm-over-budget" in capsys.readouterr().err
