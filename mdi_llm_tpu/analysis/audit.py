@@ -648,11 +648,37 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
         problems.append(f"block_size={sv.block_size} must be positive")
     if sv.max_batch < 1:
         problems.append(f"max_batch={sv.max_batch} must be positive")
+    if sv.decode_chunk < 1:
+        problems.append(f"decode_chunk={sv.decode_chunk} must be >= 1")
+    if sv.spec_k < 0:
+        problems.append(f"spec_k={sv.spec_k} must be >= 0")
+    if sv.spec_k and sv.temperature != 0.0:
+        problems.append(
+            f"spec_k={sv.spec_k} with temperature={sv.temperature:g}: "
+            "speculative serving is greedy-only (verify emits greedy "
+            "successors; ServingEngine refuses this config)"
+        )
     n_blocks = sv.num_pool_blocks(plan.seq_len) if sv.block_size >= 1 else 0
     if sv.block_size >= 1 and n_blocks < 2:
         problems.append(
             f"pool of {n_blocks} block(s) cannot serve anything (block 0 is "
             "the reserved trash block; KVPool needs >= 2)"
+        )
+    headroom = sv.reserve_headroom_blocks() if (
+        sv.block_size >= 1 and sv.decode_chunk >= 1 and sv.spec_k >= 0
+    ) else 0
+    if (
+        sv.max_blocks is not None and sv.block_size >= 1 and n_blocks >= 2
+        and n_blocks - 1 < headroom + 1
+    ):
+        # full-coverage pools (max_blocks=None) bound every slot at the
+        # window, so only hand-sized pools can under-provision the K-step
+        # reservation the chunked/speculative decode path holds per slot
+        problems.append(
+            f"max_blocks={sv.max_blocks}: {n_blocks - 1} usable block(s) "
+            f"cannot hold one slot's {headroom}-block chunk reservation "
+            f"headroom (decode_chunk={sv.decode_chunk}, spec_k={sv.spec_k}, "
+            f"double_buffer={sv.double_buffer}) plus its first write"
         )
     for p in problems:
         findings.append(_finding(plan, "bad-serving-config", p))
@@ -661,6 +687,9 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             "num_blocks": n_blocks,
             "block_size": sv.block_size,
             "pool_bytes": sv.pool_bytes(plan.cfg, plan.seq_len, plan.kv_dtype),
+            "decode_chunk": sv.decode_chunk,
+            "spec_k": sv.spec_k,
+            "reserve_headroom_blocks": headroom,
         }
 
 

@@ -145,7 +145,7 @@ def report_run(args, cfg, tokenizer, prompt_ids, outs, stats, gen_time, n_nodes,
     for i, (ids, plen) in enumerate(zip(outs, (len(p) for p in prompt_ids))):
         print(f"--- sample {i} ({len(ids) - plen} new tokens) " + "-" * 30)
         if tokenizer is not None:
-            print(tokenizer.decode(np.asarray(ids)))
+            print(tokenizer.decode(np.asarray(ids)))  # mdi-lint: disable=host-sync -- end-of-run print, not the decode loop
         else:
             print(ids)
     print(

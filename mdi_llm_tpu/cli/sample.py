@@ -40,6 +40,13 @@ def build_parser() -> argparse.ArgumentParser:
     add_run_args(ap)
     ap.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
     ap.add_argument(
+        "--scan-unroll", type=int, default=1,
+        help="layer-scan unroll factor for decode steps "
+        "(transformer.run_blocks(unroll=)): divides the per-layer "
+        "while-loop fixed cost that dominates small models "
+        "(docs/perf.md hypothesis 1; single-device engine only)",
+    )
+    ap.add_argument(
         "--speculative", type=int, default=0, metavar="K",
         help="greedy speculative decoding with K-token n-gram drafts "
         "(single sample, temperature 0; exact)",
@@ -214,6 +221,7 @@ def main(argv=None):
                 cfg, params, max_seq_length=seq_len, rng_seed=args.seed,
                 quantize=args.quantize, cache_dtype=resolve_kv_dtype(args.kv_dtype),
                 mesh=mesh, moe_capacity_factor=args.moe_capacity_factor,
+                scan_unroll=args.scan_unroll,
             )
             outs, stats = engine.generate(
                 prompt_ids, args.n_tokens, temperature=temperature,

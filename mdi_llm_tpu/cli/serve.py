@@ -58,6 +58,25 @@ def build_parser():
                     help="concurrent decode slots")
     ap.add_argument("--prefill-chunk", type=int, default=128,
                     help="max prompt tokens per prefill dispatch")
+    ap.add_argument("--decode-chunk", type=int, default=8,
+                    help="device decode steps per host sync: K steps run as "
+                    "one on-device lax.scan and the host reads tokens once "
+                    "per K, amortizing the dispatch round-trip as RTT/K "
+                    "(1 = per-step engine)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative serving: draft K tokens per request "
+                    "by n-gram prompt lookup and verify them in one ragged "
+                    "forward over the paged cache, emitting up to K+1 "
+                    "tokens per sync; greedy only (temperature 0), exact "
+                    "(0 disables)")
+    ap.add_argument("--no-double-buffer", action="store_true",
+                    help="do not overlap a decode chunk's host read with "
+                    "the next chunk's on-device compute")
+    ap.add_argument("--scan-unroll", type=int, default=1,
+                    help="layer-scan unroll factor for the decode/verify "
+                    "steps (transformer.run_blocks(unroll=)): divides the "
+                    "per-layer while-loop fixed cost that dominates small "
+                    "models (docs/perf.md hypothesis 1)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable hash-based prefix block reuse")
     ap.add_argument("--temperature", type=float, default=0.0,
@@ -109,6 +128,9 @@ def main(argv=None):
         max_blocks=args.max_blocks,
         max_batch=args.max_batch,
         prefill_chunk=args.prefill_chunk,
+        decode_chunk=args.decode_chunk,
+        spec_k=args.spec_k,
+        double_buffer=not args.no_double_buffer,
         prefix_caching=not args.no_prefix_cache,
         temperature=args.temperature,
     )
@@ -141,6 +163,7 @@ def main(argv=None):
         max_seq_length=args.sequence_length,
         cache_dtype=resolve_kv_dtype(args.kv_dtype) or dtype,
         quantize=args.quantize,
+        scan_unroll=args.scan_unroll,
     )
     # the audited config IS the engine config — no second hand-kept copy
     engine = gen.serve(serving=serving_cfg)
@@ -175,7 +198,7 @@ def main(argv=None):
         gen_tokens = out[len(prompt):]
         print(f"--- {rid} ({len(gen_tokens)} new tokens) " + "-" * 30)
         if tokenizer is not None:
-            print(tokenizer.decode(np.asarray(out)))
+            print(tokenizer.decode(np.asarray(out)))  # mdi-lint: disable=host-sync -- end-of-run print, not the serving loop
         else:
             print(gen_tokens)
 
@@ -185,6 +208,9 @@ def main(argv=None):
         "tokens_per_s": round(stats.tokens_per_s, 2),
         "wall_s": round(stats.wall_s, 2),
         "decode_steps": stats.decode_steps,
+        "host_syncs": stats.host_syncs,
+        "tokens_per_sync": round(stats.tokens_per_sync, 2),
+        "spec_accept_rate": round(stats.spec_accept_rate, 4),
         "prefill_chunks": stats.prefill_chunks,
         "kv_block_utilization_mean": round(stats.kv_utilization_mean, 4),
         "kv_block_utilization_peak": round(stats.kv_utilization_peak, 4),

@@ -449,6 +449,18 @@ class ServingConfig:
     max_batch: int = 8  # concurrent decode slots (jit batch shape)
     prefill_chunk: int = 128  # max prompt tokens per prefill dispatch
     prefix_caching: bool = True  # hash-chain block reuse for shared prompts
+    # decode dispatch ---------------------------------------------------------
+    decode_chunk: int = 8  # device decode steps per host sync (lax.scan):
+    # the host reads tokens once per K steps instead of per token, so the
+    # dispatch RTT amortizes as RTT/K (docs/perf.md "Serving host-sync").
+    # 1 = the per-step engine (one sync per token)
+    double_buffer: bool = True  # dispatch chunk N+1 (chained on device
+    # arrays) before reading chunk N, so the host read overlaps compute;
+    # engaged only while no prefill/admission/preemption work is pending
+    spec_k: int = 0  # n-gram speculative draft length for serving decode:
+    # per-slot prompt-lookup drafts verified in ONE ragged forward over the
+    # paged cache, emitting up to K+1 tokens per sync.  Greedy only
+    # (temperature must be 0) — exact, token-identical to plain decode
     # sampling (engine-wide: the decode step is one jitted batch) ------------
     temperature: float = 0.0
     top_k: Optional[int] = None
@@ -465,6 +477,20 @@ class ServingConfig:
             return int(self.max_blocks)
         per_seq = -(-int(max_seq_length) // self.block_size)
         return 1 + self.max_batch * per_seq
+
+    def reserve_headroom_blocks(self) -> int:
+        """Worst-case blocks one live slot holds AHEAD of its written tokens
+        under K-step chunk reservation (`decode_chunk`, doubled while a
+        speculative second chunk is in flight under `double_buffer`) or
+        speculative verify (`spec_k` + 1 writes), plus one block of
+        partial-block slack.  The default full-coverage pool already bounds
+        every slot at the window, so this only matters for hand-sized
+        `max_blocks` pools — the mdi-audit serving checker uses it to refuse
+        pools too small to hold even one slot's reservation."""
+        ahead = max(1, self.decode_chunk, self.spec_k + 1)
+        if self.double_buffer and self.spec_k == 0:
+            ahead += max(1, self.decode_chunk)
+        return -(-ahead // self.block_size) + 1
 
     def pool_bytes(
         self, cfg: "Config", max_seq_length: Optional[int] = None, dtype="bfloat16"

@@ -17,14 +17,19 @@ Two implementations:
   Bit-for-bit the same softmax chain as the dense op — this is what the
   tier-1 CPU parity tests pin down, and what guarantees the serving engine's
   greedy streams match `Generator.generate`.
-- **Pallas kernel** (`_paged_attention_kernel`): a TPU block-table decode
-  kernel in the spirit of "Ragged Paged Attention" (PAPERS.md, arxiv
-  2604.15464): grid `(B, max_blocks)`, the block table rides in as a
-  scalar-prefetch operand so the index map DMAs exactly the blocks each
-  sequence owns (unneeded trailing grid steps remap to block 0 and skip
-  compute), online-softmax accumulation in VMEM scratch.  Semantics are
-  validated against the fallback in interpreter mode; the fallback remains
-  the default off-TPU.
+- **Pallas kernels**: TPU block-table decode kernels in the spirit of
+  "Ragged Paged Attention" (PAPERS.md, arxiv 2604.15464): grid
+  `(B, max_blocks)`, the block table rides in as a scalar-prefetch operand
+  so the index map DMAs exactly the blocks each sequence owns (unneeded
+  trailing grid steps remap to block 0 and skip compute), online-softmax
+  accumulation in VMEM scratch.  `_paged_attention_kernel` is the
+  single-query (Tq == 1) decode step; `_paged_attention_ragged_kernel`
+  generalizes it to **ragged multi-query decode** — each sequence attends
+  with up to `Tq` query tokens at its own absolute positions, which is the
+  shape the serving engine's batched speculative verify dispatches (K
+  drafted tokens + 1 per slot, every slot at a different depth).  Semantics
+  are validated against the fallback in interpreter mode; the fallback
+  remains the default off-TPU.
 
 Writes go through `paged_update`: a scatter of the chunk's K/V into
 `(block, offset)` slots resolved through the table.  Positions past the
@@ -42,7 +47,12 @@ import jax.numpy as jnp
 
 from mdi_llm_tpu.ops.attention import NEG_INF, multihead_attention
 
-__all__ = ["paged_attention", "paged_update", "gather_paged_kv"]
+__all__ = [
+    "paged_attention",
+    "paged_update",
+    "gather_paged_kv",
+    "RAGGED_KERNEL_MAX_TQ",
+]
 
 
 def paged_update(
@@ -177,6 +187,143 @@ def _decode_kernel(
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
+# widest multi-query width the ragged kernel accepts: each (head, query)
+# pair is an independent online-softmax row in VMEM scratch, so scratch
+# grows linearly with Tq — speculative verify widths (K+1 <= ~9) are the
+# target; prefill chunks (Tq ~ 128) stay on the gather fallback
+RAGGED_KERNEL_MAX_TQ = 16
+
+
+def _ragged_decode_kernel(
+    # scalar prefetch
+    tables_ref,  # (B, MB) int32
+    lens_ref,  # (B,) int32 — valid KV length per sequence (max q_pos + 1)
+    qpos_ref,  # (B, Tq) int32 — absolute position of every query token
+    # blocks
+    q_ref,  # (1, n_head, Tq, hs)
+    k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
+    v_ref,
+    o_ref,  # (1, n_head, Tq, hs)
+    # scratch: every (head, query) pair is one independent softmax row
+    m_ref,  # (n_head * Tq, 128) f32 running max (lane-broadcast scalar)
+    l_ref,  # (n_head * Tq, 128) f32 running denominator
+    acc_ref,  # (n_head * Tq, hs) f32 running numerator
+    *,
+    block_size: int,
+    n_groups: int,
+    n_queries: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    n_live = lens_ref[b]
+
+    @pl.when(i * block_size < n_live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (n_head, Tq, hs)
+        n_head, Tq, hs = q.shape
+        q_per_kv = n_head // n_groups
+        k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
+        v = v_ref[0].astype(jnp.float32)
+        # heads map onto their KV group; the Tq queries fold into the row
+        # dim so one dot_general scores every (head, query) pair
+        qg = q.reshape(n_groups, q_per_kv * Tq, hs)
+        s = jax.lax.dot_general(
+            qg,
+            k.transpose(1, 2, 0),  # (G, hs, BS)
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s.reshape(n_head, Tq, block_size)
+        # ragged causal mask: key at absolute position j is valid for query
+        # t iff j <= q_pos[t] — the dense op's one rule, per query row
+        jpos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2
+        )
+        # scalar-prefetch reads are scalar loads; Tq is static and small
+        qpos = jnp.stack([qpos_ref[b, t] for t in range(n_queries)])
+        s = jnp.where(jpos <= qpos[None, :, None], s, NEG_INF)
+        s = s.reshape(n_head * Tq, block_size)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # (n_head * Tq, BS)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.reshape(n_groups, q_per_kv * Tq, block_size),
+            v.transpose(1, 0, 2),  # (G, BS, hs)
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(n_head * Tq, hs)
+        acc_ref[...] = corr * acc_ref[...] + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finalize():
+        # fully-masked rows (a query past the slot's live length, e.g. a
+        # padded draft lane) have l == 0; the floor keeps them finite —
+        # their output is garbage by contract and discarded by the caller
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        out = acc_ref[...] / denom
+        n_head_tq, hs = out.shape
+        o_ref[0] = out.reshape(
+            n_head_tq // n_queries, n_queries, hs
+        ).astype(o_ref.dtype)
+
+
+def _paged_attention_ragged_kernel(
+    q, k_pool, v_pool, block_tables, q_pos, scale, interpret=False
+):
+    """q: (B, n_head, Tq, hs) → (B, n_head, Tq, hs), per-slot q_pos (B, Tq)."""
+    B, n_head, Tq, hs = q.shape
+    NB, BS, G, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    lens = (jnp.max(q_pos, axis=1) + 1).astype(jnp.int32)
+    tables = block_tables.astype(jnp.int32)
+
+    def kv_index(bidx, i, tables_ref, lens_ref, qpos_ref):
+        # see _paged_attention_kernel: trailing grid steps remap to block 0
+        needed = i * BS < lens_ref[bidx]
+        return (jnp.where(needed, tables_ref[bidx, i], 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, MB),
+        in_specs=[
+            pl.BlockSpec((1, n_head, Tq, hs), lambda b, i, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, BS, G, hs), kv_index),
+            pl.BlockSpec((1, BS, G, hs), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, n_head, Tq, hs), lambda b, i, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_head * Tq, 128), jnp.float32),
+            pltpu.VMEM((n_head * Tq, 128), jnp.float32),
+            pltpu.VMEM((n_head * Tq, hs), jnp.float32),
+        ],
+    )
+    kern = functools.partial(
+        _ragged_decode_kernel,
+        block_size=BS, n_groups=G, n_queries=Tq, scale=scale,
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_head, Tq, hs), q.dtype),
+        interpret=interpret,
+    )(tables, lens, q_pos.astype(jnp.int32), q, k_pool, v_pool)
+    return out
+
+
 def _paged_attention_kernel(
     q, k_pool, v_pool, block_tables, q_pos, scale, interpret=False
 ):
@@ -233,21 +380,31 @@ def paged_attention(
 ) -> jnp.ndarray:
     """Causal GQA/MQA attention through per-sequence block tables.
 
-    Returns (B, n_head, Tq, hs).  Tq > 1 (chunked prefill attending through
-    the pool) always takes the gather fallback; the kernel covers the hot
-    Tq == 1 decode step.
+    Returns (B, n_head, Tq, hs).  Tq == 1 (the hot decode step) runs the
+    single-query kernel; 1 < Tq <= RAGGED_KERNEL_MAX_TQ (ragged speculative
+    verify: each slot scores K+1 tokens at its own depth) runs the ragged
+    multi-query kernel; wider Tq (chunked prefill attending through the
+    pool) always takes the gather fallback.
     """
     hs = q.shape[-1]
+    Tq = q.shape[2]
     if scale is None:
         scale = 1.0 / (hs**0.5)
     if use_kernel is None:
         use_kernel = (
             _HAS_PALLAS
             and jax.default_backend() == "tpu"
-            and q.shape[2] == 1
+            and Tq <= RAGGED_KERNEL_MAX_TQ
         )
-    if use_kernel and q.shape[2] == 1 and _HAS_PALLAS:
-        return _paged_attention_kernel(
-            q, k_pool, v_pool, block_tables, q_pos, scale, interpret=interpret
-        )
+    if use_kernel and _HAS_PALLAS:
+        if Tq == 1:
+            return _paged_attention_kernel(
+                q, k_pool, v_pool, block_tables, q_pos, scale,
+                interpret=interpret,
+            )
+        if Tq <= RAGGED_KERNEL_MAX_TQ:
+            return _paged_attention_ragged_kernel(
+                q, k_pool, v_pool, block_tables, q_pos, scale,
+                interpret=interpret,
+            )
     return _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale)

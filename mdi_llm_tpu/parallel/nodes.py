@@ -166,7 +166,7 @@ def check_params_consistency(params, rtol: float = 1e-3) -> None:
 
     sig = []
     for leaf in jax.tree_util.tree_leaves(params):
-        a = np.asarray(leaf).ravel()
+        a = np.asarray(leaf).ravel()  # mdi-lint: disable=host-sync -- one-shot startup checksum, not a step loop
         stride = max(1, a.size // 4096)
         sig.append(float(np.sum(a[::stride], dtype=np.float64)))
     sig = np.asarray(sig, np.float64)

@@ -19,8 +19,19 @@ are all invisible to the math.
 
 Device dispatch shapes stay bounded: prefill chunks use the same
 power-of-two buckets as `generation.py` (one compile per bucket) at B=1,
-and decode is a fixed `(max_batch, 1)` step (dead lanes ride along as
-padding writing into the pool's trash block).
+and decode is a fixed `(max_batch, decode_chunk)` scan (dead lanes ride
+along as padding writing into the pool's trash block).
+
+Host-sync amortization (docs/perf.md "Serving host-sync & speculative"):
+with `decode_chunk=K` the inner loop runs K decode steps in ONE jitted
+`lax.scan` — per-slot remaining-budget and stop-token masks freeze
+finished lanes on device — and the host reads tokens once per K steps
+instead of per token; with `double_buffer` chunk N+1 is dispatched
+(chained on device arrays) before chunk N's tokens are read, so the read
+overlaps compute.  `spec_k=K` adds batched speculative decoding: per-slot
+n-gram drafts verified in one ragged multi-query forward over the paged
+cache (`ops/paged_attention.py`), emitting up to K+1 tokens per sync —
+greedy-only, exact.
 """
 
 from __future__ import annotations
@@ -38,8 +49,11 @@ from mdi_llm_tpu.config import ServingConfig
 from mdi_llm_tpu.generation import (
     Generator,
     _bucket,
+    accept_draft,
     detect_stop_tokens,
     find_eot,
+    ngram_draft,
+    pad_draft,
 )
 from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.ops.sampling import (
@@ -59,7 +73,10 @@ class ServingStats:
     tokens_generated: int = 0
     prefill_tokens: int = 0
     prefill_chunks: int = 0
-    decode_steps: int = 0
+    decode_steps: int = 0  # device decode steps (scan iterations + verifies)
+    host_syncs: int = 0  # decode/verify host reads (one per chunk dispatch)
+    spec_drafted: int = 0  # draft tokens scored by speculative verify
+    spec_accepted: int = 0  # draft tokens accepted (emitted without a step)
     requests_finished: int = 0
     preemptions: int = 0
     prefix_cache_hits: int = 0  # blocks reused copy-free
@@ -80,6 +97,17 @@ class ServingStats:
     @property
     def tokens_per_s(self) -> float:
         return self.tokens_generated / self.wall_s if self.wall_s else 0.0
+
+    @property
+    def tokens_per_sync(self) -> float:
+        """Generated tokens per decode-path host read — the amortization
+        the chunked/speculative loop buys (per-step serving pins this at
+        ~1 plus the prefill-sampled tokens)."""
+        return self.tokens_generated / self.host_syncs if self.host_syncs else 0.0
+
+    @property
+    def spec_accept_rate(self) -> float:
+        return self.spec_accepted / self.spec_drafted if self.spec_drafted else 0.0
 
     @property
     def kv_utilization_mean(self) -> float:
@@ -112,6 +140,16 @@ class ServingEngine:
         bs = serving.block_size
         if bs < 1:
             raise ValueError("block_size must be positive")
+        if serving.decode_chunk < 1:
+            raise ValueError("decode_chunk must be >= 1")
+        if serving.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        if serving.spec_k and serving.temperature != 0.0:
+            raise ValueError(
+                "speculative serving (spec_k > 0) requires temperature=0: "
+                "verify emits greedy successors, so only greedy streams are "
+                "exact (the shared_prefill reproducibility rule)"
+            )
         self.max_seq_length = gen.max_seq_length
         # blocks per sequence table: full coverage of the engine window
         self.max_blocks_per_seq = -(-self.max_seq_length // bs)
@@ -127,7 +165,24 @@ class ServingEngine:
         self._kv = transformer.init_paged_kv_cache(
             gen.cfg, num_blocks, bs, dtype=gen.cache_dtype
         )
-        self._fns: Dict[Any, Any] = {}
+        # persistent host-side block table, updated incrementally as blocks
+        # are appended / slots reassigned — rebuilding the full
+        # (max_batch, max_blocks_per_seq) ndarray per decode dispatch was
+        # O(table) of host work per token
+        self._tables = np.zeros(
+            (serving.max_batch, self.max_blocks_per_seq), np.int32
+        )
+        self._table_seq: List[Optional[SequenceState]] = (
+            [None] * serving.max_batch
+        )
+        self._table_len = [0] * serving.max_batch
+        # compiled-phase cache, shared across engines of the same Generator:
+        # every other serving knob (temperature/top_p are traced operands;
+        # pool geometry/batch/chunk widths key the entries via call shapes)
+        # leaves the traces unchanged, so only use_kernel partitions it
+        self._fns: Dict[Any, Any] = gen._serve_fns.setdefault(
+            ("serve", serving.use_kernel), {}
+        )
         # sampling knobs are engine-lifetime constants: upload the traced
         # operands once, not two tiny transfers per decode step
         self._t_op, self._p_op = sampling_operands(
@@ -146,13 +201,16 @@ class ServingEngine:
         key_ = ("prefill", T)
         if key_ not in self._fns:
             gen = self.gen
+            use_kernel = self.cfg.use_kernel  # no self in the closure: the
+            # fn cache outlives this engine (gen._serve_fns) and capturing
+            # self would pin its entire paged pool for the Generator's life
 
             @partial(jax.jit, donate_argnums=(2,))
             def prefill(params, tokens, kv, tables, pos0, true_len):
                 logits, kv = transformer.forward(
                     gen.cfg, params, tokens, pos0, kv=kv, rope=gen.rope,
                     moe_impl=gen._moe_impl, paged_tables=tables,
-                    paged_kernel=self.cfg.use_kernel,
+                    paged_kernel=use_kernel,
                 )
                 last = jnp.take_along_axis(
                     logits, (true_len - 1)[:, None, None], axis=1
@@ -166,6 +224,7 @@ class ServingEngine:
         key_ = ("decode", B)
         if key_ not in self._fns:
             gen = self.gen
+            use_kernel = self.cfg.use_kernel  # see _prefill_fn: no self
 
             # float knobs ride as traced operands; the cache keys only on
             # (mode, top_k) — a per-request temperature sweep would otherwise
@@ -180,7 +239,7 @@ class ServingEngine:
                     gen.cfg, params, tok[:, None], input_pos, kv=kv,
                     rope=gen.rope, moe_impl=gen._moe_impl,
                     unroll=gen.scan_unroll, paged_tables=tables,
-                    paged_kernel=self.cfg.use_kernel,
+                    paged_kernel=use_kernel,
                 )
                 key, sub = jax.random.split(key)
                 nxt = sample_traced(
@@ -190,6 +249,89 @@ class ServingEngine:
                 return nxt.astype(jnp.int32), kv, key
 
             self._fns[key_] = decode
+        return self._fns[key_]
+
+    def _decode_chunk_fn(self, B: int, K: int):
+        """K batched decode steps scanned INSIDE one jit call over the paged
+        pool — the host syncs once per K tokens instead of per token.
+
+        Per-slot masks keep finished lanes inert without branching the
+        trace: `limit` is the number of steps a slot may advance (its
+        remaining budget/window, 0 for dead lanes) and `stop_tok` its
+        single-token stop id (-1 for none).  A frozen lane re-forwards its
+        last (token, position) pair each remaining step, which rewrites the
+        identical K/V bytes in place — combined with strictly-by-absolute-
+        position masking and the zero-table → trash-block redirect for
+        dead lanes, no masked step can perturb any live slot's stream, so
+        the retained tokens are bit-identical to the per-step engine's."""
+        key_ = ("decode_chunk", B, K)
+        if key_ not in self._fns:
+            gen = self.gen
+            use_kernel = self.cfg.use_kernel  # see _prefill_fn: no self
+
+            # float knobs ride as traced operands (see _decode_fn)
+            @partial(
+                jax.jit, donate_argnums=(2,),
+                static_argnames=("mode", "top_k"),
+            )
+            def decode_chunk(params, tok0, kv, tables, pos0, limit, stop_tok,
+                             key, temperature, top_p, mode, top_k):
+                def body(carry, i):
+                    tok, kv, pos, done, key = carry
+                    active = jnp.logical_and(i < limit, ~done)
+                    logits, kv = transformer.forward(
+                        gen.cfg, params, tok[:, None], pos, kv=kv,
+                        rope=gen.rope, moe_impl=gen._moe_impl,
+                        unroll=gen.scan_unroll, paged_tables=tables,
+                        paged_kernel=use_kernel,
+                    )
+                    key, sub = jax.random.split(key)
+                    nxt = sample_traced(
+                        logits[:, -1], sub, temperature, top_p,
+                        mode=mode, top_k=top_k,
+                    ).astype(jnp.int32)
+                    nxt = jnp.where(active, nxt, tok)  # frozen lanes hold
+                    done = jnp.logical_or(
+                        done, jnp.logical_and(active, nxt == stop_tok)
+                    )
+                    pos = pos + active.astype(pos.dtype)
+                    return (nxt, kv, pos, done, key), nxt
+
+                done0 = jnp.zeros((B,), bool)
+                (tok, kv, pos, done, key), toks = jax.lax.scan(
+                    body, (tok0, kv, pos0, done0, key),
+                    jnp.arange(K, dtype=jnp.int32),
+                )
+                # final carry rides back so double-buffering can chain the
+                # next chunk on device arrays without a host read
+                return toks, tok, pos, kv, key  # toks: (K, B)
+
+            self._fns[key_] = decode_chunk
+        return self._fns[key_]
+
+    def _verify_fn(self, B: int, T: int):
+        """Batched greedy speculative verify over the paged pool: score T
+        tokens per slot ([pending] + K drafted) in ONE ragged multi-query
+        forward — every slot at its own depth, per-slot q_pos masking in
+        `ops/paged_attention.py` — and return the greedy successor at every
+        position.  Stale K/V past a rejected draft is invisible until
+        overwritten (absolute-position masking), the same contract the
+        single-sequence `Generator._verify_fn` relies on."""
+        key_ = ("verify", B, T)
+        if key_ not in self._fns:
+            gen = self.gen
+            use_kernel = self.cfg.use_kernel  # see _prefill_fn: no self
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def verify(params, tokens, kv, tables, pos0):
+                logits, kv = transformer.forward(
+                    gen.cfg, params, tokens, pos0, kv=kv, rope=gen.rope,
+                    moe_impl=gen._moe_impl, unroll=gen.scan_unroll,
+                    paged_tables=tables, paged_kernel=use_kernel,
+                )
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+            self._fns[key_] = verify
         return self._fns[key_]
 
     # -- request surface -----------------------------------------------------
@@ -213,6 +355,36 @@ class ServingEngine:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
         row[: len(seq.blocks)] = seq.blocks
         return row
+
+    def _sync_tables(self, live: Sequence[SequenceState]) -> np.ndarray:
+        """The persistent (max_batch, max_blocks_per_seq) block table for a
+        decode dispatch, updated incrementally: appended blocks extend a
+        slot's row in place, a reassigned slot rewrites its row, and every
+        slot NOT in `live` is zeroed.  The zeroing is load-bearing, not
+        cosmetic: dead/prefilling lanes ride the batched dispatch writing
+        at position 0, and a stale row would route that garbage into a
+        real block (worst case a prefix-cached block another request
+        attends) — a zero row redirects it to the reserved trash block."""
+        want: List[Optional[SequenceState]] = [None] * self.scheduler.max_batch
+        for seq in live:
+            want[seq.slot] = seq
+        for slot, seq in enumerate(want):
+            if seq is None:
+                if self._table_seq[slot] is not None or self._table_len[slot]:
+                    self._tables[slot] = 0
+                    self._table_seq[slot], self._table_len[slot] = None, 0
+                continue
+            n = len(seq.blocks)
+            if seq is not self._table_seq[slot] or n < self._table_len[slot]:
+                row = self._tables[slot]
+                row[:] = 0
+                row[:n] = seq.blocks
+                self._table_seq[slot], self._table_len[slot] = seq, n
+            elif n > self._table_len[slot]:
+                self._tables[slot, self._table_len[slot]: n] = \
+                    seq.blocks[self._table_len[slot]:]
+                self._table_len[slot] = n
+        return self._tables
 
     # -- execution -----------------------------------------------------------
 
@@ -296,26 +468,31 @@ class ServingEngine:
         self.scheduler.retire(seq)
         self.stats.requests_finished += 1
 
-    def _run_decode(self, seqs: List[SequenceState]) -> None:
-        t0 = time.perf_counter()
-        # every live sequence needs a slot for this step's KV write; growth
-        # may preempt — drop any sequence that lost its own slot
+    def _live_reserved(
+        self, seqs: List[SequenceState], n_writes_of,
+    ) -> List[SequenceState]:
+        """Filter to sequences that still own their slot AND have block
+        coverage for their next writes; growth may preempt — drop any
+        sequence that lost its own slot in the process."""
         live: List[SequenceState] = []
         for seq in seqs:
             if self.scheduler.slots[seq.slot] is seq and \
-                    self.scheduler.ensure_block_for(seq):
+                    self.scheduler.ensure_blocks_for(seq, n_writes_of(seq)):
                 live.append(seq)
-        live = [s for s in live if self.scheduler.slots[s.slot] is s]
+        return [s for s in live if self.scheduler.slots[s.slot] is s]
+
+    def _run_decode(self, seqs: List[SequenceState]) -> None:
+        t0 = time.perf_counter()
+        live = self._live_reserved(seqs, lambda s: 1)
         if not live:
             return
         B = self.scheduler.max_batch
         tok = np.zeros((B,), np.int32)
         pos = np.zeros((B,), np.int32)
-        tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
         for seq in live:
             tok[seq.slot] = seq.next_tok
             pos[seq.slot] = seq.fed
-            tables[seq.slot] = self._table_row(seq)
+        tables = self._sync_tables(live)
         kv = self._kv
         self._kv = None  # donated
         try:
@@ -329,11 +506,225 @@ class ServingEngine:
             raise
         nxt = np.asarray(nxt)
         self.stats.decode_steps += 1
+        self.stats.host_syncs += 1
         self.stats.observe_kv_utilization(self.pool.utilization)
         for seq in live:
             seq.fed += 1
             self._emit(seq, int(nxt[seq.slot]))
         self.stats.decode_s += time.perf_counter() - t0
+
+    # -- chunked decode (the multi-token serving step) ------------------------
+
+    def _chunk_limit(self, seq: SequenceState, K: int, ahead: int = 0) -> int:
+        """Steps this slot may actually advance in a K-step chunk: its
+        remaining token budget and window room, minus `ahead` tokens already
+        committed to an in-flight (undrained) chunk."""
+        remaining = seq.req.max_new_tokens - seq.n_generated - ahead
+        window = self.max_seq_length - len(seq.tokens) - ahead
+        return max(0, min(K, remaining, window))
+
+    @staticmethod
+    def _stop1(seq: SequenceState) -> int:
+        """The slot's single-token stop id for the device-side stop mask
+        (-1 for none).  Multi-token stop sequences are detected host-side
+        between chunks, exactly like `Generator.generate`'s chunked loop —
+        the extra computed tokens are discarded, the stream is unchanged."""
+        for s in seq.req.stop_sequences:
+            if len(s) == 1:
+                return int(s[0])
+        return -1
+
+    def _drain_tokens(
+        self, live: List[SequenceState], limits: np.ndarray, toks: np.ndarray,
+    ) -> bool:
+        """Credit one drained chunk to the scheduler state: emit each live
+        slot's retained tokens (up to its limit, stopping at the first
+        host-detected stop/budget retirement).  Returns True when every
+        slot emitted its full limit and survived — the precondition for
+        chaining another speculative chunk."""
+        self.stats.host_syncs += 1
+        self.stats.observe_kv_utilization(self.pool.utilization)
+        clean = True
+        for seq in live:
+            if self.scheduler.slots[seq.slot] is not seq:
+                clean = False  # lost the slot while the chunk was in flight
+                continue
+            lim = int(limits[seq.slot])
+            emitted = 0
+            for s in range(lim):
+                seq.fed += 1
+                emitted += 1
+                self._emit(seq, int(toks[s, seq.slot]))
+                if seq.done:
+                    break
+            if seq.done or emitted < lim:
+                clean = False
+        return clean
+
+    def _can_pipeline(self) -> bool:
+        """Double-buffering is only sound while the scheduler has no other
+        work: an admission/prefill would change the live set mid-flight,
+        and a preemption would free blocks the device is still writing.
+        With spec_k the chunk is only the no-draft fallback — control must
+        return to the scheduler after every chunk so freshly-echoing slots
+        switch back to the verify path."""
+        sched = self.scheduler
+        return (
+            self.cfg.double_buffer
+            and not self.cfg.spec_k
+            and not sched.waiting
+            and not sched.preempted
+            and not any(s.needs_prefill for s in sched.running())
+        )
+
+    def _run_decode_chunk(self, seqs: List[SequenceState]) -> None:
+        """One decode action in chunked mode: scan K steps on device per
+        host sync, and — while no other scheduler work is pending —
+        double-buffer the dispatch so chunk N's host read overlaps chunk
+        N+1's compute (the next chunk chains on the scan's final carry,
+        device-to-device; block reservation for it must succeed WITHOUT
+        preemption, since a preempted victim's blocks could be reallocated
+        while the in-flight chunk still writes them)."""
+        t0 = time.perf_counter()
+        K = self.cfg.decode_chunk
+        live = self._live_reserved(seqs, lambda s: self._chunk_limit(s, K))
+        if not live:
+            return
+        B = self.scheduler.max_batch
+        fn = self._decode_chunk_fn(B, K)
+        tok = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        stop1 = np.full((B,), -1, np.int32)
+        limits = np.zeros((B,), np.int32)
+        for seq in live:
+            tok[seq.slot] = seq.next_tok
+            pos[seq.slot] = seq.fed
+            stop1[seq.slot] = self._stop1(seq)
+            limits[seq.slot] = self._chunk_limit(seq, K)
+        tok_d, pos_d = jnp.asarray(tok), jnp.asarray(pos)
+        stop_d = jnp.asarray(stop1)
+        tables = self._sync_tables(live)
+        pending = None  # (limits, sampled tokens still on device)
+        while True:
+            kv = self._kv
+            self._kv = None  # donated
+            try:
+                toks_j, tok_d, pos_d, self._kv, self.gen.key = fn(
+                    self.gen.params, tok_d, kv, jnp.asarray(tables), pos_d,
+                    jnp.asarray(limits), stop_d, self.gen.key,
+                    self._t_op, self._p_op,
+                    mode=self._sample_mode, top_k=self.cfg.top_k,
+                )
+            except Exception:
+                self._kv = kv  # see _run_prefill: keep failures diagnosable
+                raise
+            self.stats.decode_steps += K
+            clean = True
+            if pending is not None:
+                prev_limits, prev_toks = pending
+                # THE chunk-boundary sync: one host read per K decode steps,
+                # overlapping the chunk dispatched above
+                toks_np = np.asarray(prev_toks)  # mdi-lint: disable=host-sync -- the intentional chunk-boundary read; everything else in this loop stays on device
+                clean = self._drain_tokens(live, prev_limits, toks_np)
+            pending = (limits, toks_j)
+            if not (clean and self._can_pipeline()):
+                break
+            # project the next chunk's limits assuming full emission; a
+            # slot that just exhausted its budget projects to 0 (frozen)
+            nxt = np.zeros((B,), np.int32)
+            for seq in live:
+                nxt[seq.slot] = self._chunk_limit(
+                    seq, K, ahead=int(limits[seq.slot])
+                )
+            if not nxt.any():
+                break
+            ok = True
+            for seq in live:
+                ok = ok and self.scheduler.try_reserve(
+                    seq, int(limits[seq.slot]) + int(nxt[seq.slot])
+                )
+            if not ok:
+                break  # pool too tight to reserve without preemption
+            limits = nxt
+            tables = self._sync_tables(live)
+        prev_limits, prev_toks = pending
+        self._drain_tokens(live, prev_limits, np.asarray(prev_toks))
+        self.stats.decode_s += time.perf_counter() - t0
+
+    # -- batched speculative decode (ragged verify over the paged cache) ------
+
+    def _run_spec_decode(self, seqs: List[SequenceState]) -> bool:
+        """Batched speculative serving step: draft up to `spec_k` tokens per
+        slot by prompt-lookup (`ngram_draft` over prompt + generation, the
+        machinery `generate()`'s B=1 fast path uses), score every slot's
+        [pending] + draft in ONE ragged verify forward over the paged
+        cache, and emit each slot's accepted prefix + bonus token.  Returns
+        False when NO slot drafted — the caller falls back to a plain
+        chunked burst (a (K+1)-wide verify would burn (K+1)x the step cost
+        to emit one token per slot)."""
+        K = self.cfg.spec_k
+        candidates = [
+            s for s in seqs if self.scheduler.slots[s.slot] is s
+        ]
+        drafts: Dict[int, List[int]] = {}
+        for seq in candidates:
+            # draft only with window room for all K+1 writes and at least
+            # 2 tokens of budget left (a 1-token tail gains nothing); cap
+            # the draft at remaining-1 so the reservation below never
+            # exceeds the blocks_needed(prompt+max_new) worst case that
+            # admission guaranteed — an uncapped draft on a hand-sized
+            # pool could demand coverage no preemption can free (livelock)
+            room = self.max_seq_length - seq.fed - 1
+            remaining = seq.req.max_new_tokens - seq.n_generated
+            if room >= K + 1 and remaining >= 2:
+                d = ngram_draft(seq.tokens, K)[: remaining - 1]
+                if d:
+                    drafts[seq.slot] = [int(t) for t in d]
+        if not drafts:
+            return False
+        t0 = time.perf_counter()
+        live = self._live_reserved(
+            candidates, lambda s: len(drafts.get(s.slot, ())) + 1
+        )
+        if not live:
+            self.stats.decode_s += time.perf_counter() - t0
+            return True
+        B = self.scheduler.max_batch
+        toks_in = np.zeros((B, K + 1), np.int32)
+        pos = np.zeros((B,), np.int32)
+        for seq in live:
+            row = [int(seq.next_tok)] + pad_draft(drafts.get(seq.slot, []), K)
+            toks_in[seq.slot] = row
+            pos[seq.slot] = seq.fed
+        tables = self._sync_tables(live)
+        kv = self._kv
+        self._kv = None  # donated
+        try:
+            g, self._kv = self._verify_fn(B, K + 1)(
+                self.gen.params, jnp.asarray(toks_in), kv,
+                jnp.asarray(tables), jnp.asarray(pos),
+            )
+        except Exception:
+            self._kv = kv  # see _run_prefill: keep failures diagnosable
+            raise
+        g = np.asarray(g)
+        self.stats.decode_steps += 1
+        self.stats.host_syncs += 1
+        self.stats.observe_kv_utilization(self.pool.utilization)
+        for seq in live:
+            d = drafts.get(seq.slot, [])
+            # accept only over the REAL draft length: a 0-padded row must
+            # not luck into matching the model's 0-token successor
+            burst = accept_draft(pad_draft(d, K), g[seq.slot], len(d))
+            self.stats.spec_drafted += len(d)
+            self.stats.spec_accepted += len(burst) - 1
+            for t in burst:
+                seq.fed += 1
+                self._emit(seq, int(t))
+                if seq.done:
+                    break
+        self.stats.decode_s += time.perf_counter() - t0
+        return True
 
     def step(self) -> bool:
         """Run one scheduler action; False when nothing was runnable."""
@@ -343,6 +734,10 @@ class ServingEngine:
         if action[0] == "prefill":
             _, seq, chunk = action
             self._run_prefill(seq, chunk)
+        elif self.cfg.spec_k and self._run_spec_decode(action[1]):
+            pass  # speculative verify served this decode turn
+        elif self.cfg.decode_chunk > 1:
+            self._run_decode_chunk(action[1])
         else:
             self._run_decode(action[1])
         return True

@@ -222,11 +222,20 @@ class Scheduler:
         self.preemptions += 1
         return True
 
-    def ensure_block_for(self, seq: SequenceState) -> bool:
-        """Grow a decoding sequence's table to cover its next write (one
-        block at a time); preempt others until it fits.  False if the
-        sequence itself was preempted."""
-        while self.pool.blocks_needed(seq.fed + 1) > len(seq.blocks):
+    def ensure_blocks_for(self, seq: SequenceState, n_writes: int = 1) -> bool:
+        """Grow a decoding sequence's table to cover its next `n_writes`
+        positions (`fed .. fed+n_writes-1` — a K-step decode chunk or a
+        speculative verify's K+1 tokens), one block at a time; preempt
+        others until it fits.  False if the sequence itself was preempted.
+
+        Rollback contract for speculative reservation: blocks reserved
+        ahead of the written tokens are rolled back to the pool through the
+        normal release path — `retire` (early stop mid-chunk) and
+        `preempt_latest` both release the sequence's WHOLE table, and the
+        engine caps `n_writes` at the slot's remaining budget/window so a
+        live sequence never holds coverage it cannot use."""
+        target = seq.fed + max(1, int(n_writes))
+        while self.pool.blocks_needed(target) > len(seq.blocks):
             got = self.pool.alloc(1)
             if got is not None:
                 seq.blocks.extend(got)
@@ -235,6 +244,27 @@ class Scheduler:
                 raise RuntimeError("KV pool exhausted with nothing to preempt")
             if self.slots[seq.slot] is not seq:  # self-preempted
                 return False
+        return True
+
+    # back-compat alias (the per-step decode path reserves one write)
+    def ensure_block_for(self, seq: SequenceState) -> bool:
+        return self.ensure_blocks_for(seq, 1)
+
+    def try_reserve(self, seq: SequenceState, n_writes: int) -> bool:
+        """Non-preempting variant of `ensure_blocks_for`, for reservations
+        made while a dispatched chunk is still in flight (double-buffering):
+        preempting here would free blocks the device is actively writing.
+        Partial growth on failure is safe — the extra blocks ride on the
+        sequence and roll back with its table."""
+        need = self.pool.blocks_needed(
+            seq.fed + max(1, int(n_writes))
+        ) - len(seq.blocks)
+        if need <= 0:
+            return True
+        got = self.pool.alloc(need)
+        if got is None:
+            return False
+        seq.blocks.extend(got)
         return True
 
     # -- action selection ----------------------------------------------------

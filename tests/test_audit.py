@@ -209,6 +209,64 @@ def test_bad_serving_config_rejected():
     assert r.breakdown["per_device"]["kv_bytes"] == 0
 
 
+def test_serving_chunk_headroom_budgeted():
+    """The pool-sizing audit accounts for K-step reservation headroom: a
+    hand-sized max_blocks pool that cannot hold even one slot's chunk
+    reservation is refused, while the full-coverage default stays clean
+    and the kv_pool breakdown reports the headroom."""
+    sv = ServingConfig(block_size=4, decode_chunk=16, double_buffer=True)
+    # per-slot headroom: ceil(2*16/4)+1 = 9 blocks; a 5-block pool fails
+    assert sv.reserve_headroom_blocks() == 9
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=4, decode_chunk=16, max_blocks=6),
+    ))
+    assert "bad-serving-config" in codes(r)
+    assert any("chunk reservation" in f.message for f in r.findings)
+    # full-coverage default: clean, and the breakdown carries the knobs
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=4, decode_chunk=16, spec_k=0),
+    ))
+    assert "bad-serving-config" not in codes(r)
+    pool = r.breakdown["kv_pool"]
+    assert pool["decode_chunk"] == 16 and pool["reserve_headroom_blocks"] == 9
+    # speculative serving is greedy-only: the auditor flags it statically
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=4, spec_k=4, temperature=0.8),
+    ))
+    assert "bad-serving-config" in codes(r)
+    assert any("greedy" in f.message for f in r.findings)
+
+
+def test_pool_estimate_byte_exact_vs_live_engine_with_chunk_reservations():
+    """The audited kv_pool bytes must equal the live engine's allocated
+    pool byte-for-byte when chunked decode / speculative verify are on —
+    chunk reservation changes which blocks are HELD, never how many the
+    pool allocates (`ServingConfig.num_pool_blocks` is shared by both)."""
+    import jax
+
+    from mdi_llm_tpu.generation import Generator
+    from mdi_llm_tpu.models import transformer
+
+    cfg = tiny()
+    sv = ServingConfig(
+        block_size=8, max_batch=4, decode_chunk=8, spec_k=4,
+        double_buffer=True,
+    )
+    seq_len = 64
+    r = audit_plan(PlanSpec(cfg=cfg, serving=sv, max_seq_length=seq_len,
+                            cache_dtype="float32"))
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    engine = Generator(
+        cfg, params, max_seq_length=seq_len, cache_dtype="float32"
+    ).serve(serving=sv)
+    live = sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(engine._kv))
+    assert r.breakdown["kv_pool"]["pool_bytes"] == live
+    assert r.breakdown["kv_pool"]["num_blocks"] == engine.pool.num_blocks
+
+
 def test_findings_reuse_lint_baseline_machinery():
     cfg = Config.from_name("tiny-llama-1.1b")
     plan = PlanSpec(cfg=cfg, mesh=MeshSpec.from_dict({"tp": 3}), tp_axis="tp")

@@ -17,6 +17,7 @@ def _args(**kw):
         suite_budget=kw.pop("suite_budget", 600.0),
         rows=kw.pop("rows", None),
         probe_timeout=kw.pop("probe_timeout", 5.0),
+        probe_retries=kw.pop("probe_retries", 1),
     )
     for k, v in kw.items():
         setattr(ns, k, v)
@@ -167,6 +168,54 @@ def test_everything_fails_still_emits(monkeypatch):
 def test_baseline_for_routes_by_model():
     assert bench.baseline_for("Llama-3-8B-Instruct") == bench.JETSON_8B_TOKENS_PER_S
     assert bench.baseline_for("tiny-llama-1.1b") == bench.REFERENCE_TOKENS_PER_S
+
+
+def test_probe_budget_env_overrides(monkeypatch):
+    """The probe budget is configurable without editing flags (driver-run
+    suites only control the environment): MDI_BENCH_PROBE_TIMEOUT /
+    MDI_BENCH_PROBE_RETRIES feed the parser defaults."""
+    monkeypatch.setenv("MDI_BENCH_PROBE_TIMEOUT", "33.5")
+    monkeypatch.setenv("MDI_BENCH_PROBE_RETRIES", "3")
+    args = bench.build_parser().parse_args([])
+    assert args.probe_timeout == 33.5
+    assert args.probe_retries == 3
+    # explicit flags still win over the env defaults
+    args = bench.build_parser().parse_args(
+        ["--probe-timeout", "7", "--probe-retries", "0"]
+    )
+    assert args.probe_timeout == 7.0 and args.probe_retries == 0
+
+
+def test_probe_failures_respect_retry_budget(monkeypatch):
+    """BENCH_r05 burned 900 s on probe timeouts: with N retries the suite
+    must launch exactly N+1 probes before the CPU fallback, not a fixed 4."""
+    probes = []
+
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            probes.append(timeout)
+            return None, "error: no backend"
+        return _row(0.7), None
+
+    out = run_suite_with(monkeypatch, child, probe_retries=2)
+    assert len(probes) == 3
+    assert "tinyllama-bf16-cpu-fallback" in out["detail"]["rows"]
+
+    probes.clear()
+    run_suite_with(monkeypatch, child, probe_retries=0)
+    assert len(probes) == 1  # zero-retry budget: one attempt, straight to CPU
+
+    # a raised budget is honored for TIMEOUT failures too (the slow-tunnel
+    # bring-up case the env knob exists for)
+    def child_timeout(argv, timeout, env=None):
+        if "--probe" in argv:
+            probes.append(timeout)
+            return None, "timeout"
+        return _row(0.7), None
+
+    probes.clear()
+    run_suite_with(monkeypatch, child_timeout, probe_retries=4)
+    assert len(probes) == 5
 
 
 def test_costly_compiles_run_after_every_decode_row():

@@ -48,11 +48,20 @@ def f(x):
 """,
     "host-sync": """
 import jax
+import numpy as np
 
 def collect(emits):
     for e in emits:
         out = jax.device_get(e)
     return out
+
+def decode_loop(step, tok):
+    toks = []
+    for _ in range(8):
+        tok_j = step(tok)
+        tok = np.asarray(tok_j)  # per-token fetch of a device value
+        toks.append(int(tok[0]))
+    return toks
 """,
     "tracer-branch": """
 import jax
@@ -138,9 +147,19 @@ def host_side(y):
 """,
     "host-sync": """
 import jax
+import numpy as np
 
 def collect(emits):
     return jax.device_get(emits)  # mdi-lint: disable=host-sync -- one batched fetch
+
+def decode_chunks(chunk_fn, tok, prompts):
+    toks = []
+    for i, p in enumerate(prompts):
+        batch = np.asarray(p, np.int32)  # host dtype conversion: not a fetch
+        toks_j, tok = chunk_fn(batch, tok)  # K steps on device per dispatch
+        chunk = np.asarray(toks_j)  # mdi-lint: disable=host-sync -- chunk-boundary read: one sync per K steps
+        toks.extend(chunk.tolist())
+    return toks
 """,
     "tracer-branch": """
 import jax

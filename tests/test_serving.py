@@ -239,13 +239,16 @@ def test_engine_prefix_cache_reuses_blocks(served_model):
 
 def test_engine_preemption_preserves_parity(served_model):
     """A pool too small for the whole batch forces recompute preemption;
-    outputs must still be token-identical to solo runs."""
+    outputs must still be token-identical to solo runs.  decode_chunk=1
+    pins the per-step engine (this pool size forces its one-block-at-a-time
+    growth dry); the chunked engine's preemption twin is below."""
     cfg, params = served_model
     rng = np.random.default_rng(9)
     prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
                for n in (9, 13, 11)]
     engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
-        block_size=4, max_batch=3, max_blocks=1 + 14, prefix_caching=False
+        block_size=4, max_batch=3, max_blocks=1 + 14, prefix_caching=False,
+        decode_chunk=1,
     )
     for i, p in enumerate(prompts):
         engine.add_request(f"p{i}", p, 10)
@@ -254,6 +257,227 @@ def test_engine_preemption_preserves_parity(served_model):
     want = _sequential_greedy(cfg, params, prompts, [10, 10, 10])
     for i in range(len(prompts)):
         assert results[f"p{i}"] == want[i], f"p{i} diverged across preemption"
+
+
+@pytest.mark.parametrize("chunk,buffered", [(4, True), (8, False)])
+def test_chunked_preemption_preserves_parity(served_model, chunk, buffered):
+    """The chunked engine's K-step block reservation under a dry pool:
+    admission succeeds (per-request footprints fit) but chunk reservations
+    exhaust the pool mid-decode, forcing preemption — and the unused
+    speculative blocks of preempted/retired sequences roll back (pool
+    drains to 0 at the end).  Outputs stay token-identical to solo runs."""
+    cfg, params = served_model
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+               for n in (9, 13, 11)]
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=3, max_blocks=1 + 10, prefix_caching=False,
+        decode_chunk=chunk, double_buffer=buffered,
+    )
+    for i, p in enumerate(prompts):
+        engine.add_request(f"p{i}", p, 10)
+    results, stats = engine.run()
+    assert stats.preemptions >= 1, "pool was sized to force preemption"
+    want = _sequential_greedy(cfg, params, prompts, [10, 10, 10])
+    for i in range(len(prompts)):
+        assert results[f"p{i}"] == want[i], f"p{i} diverged across preemption"
+    assert engine.pool.used == 0  # speculative reservations rolled back
+
+
+# ---------------------------------------------------------------------------
+# Multi-token serving steps: chunked decode + batched speculative verify
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk,buffered", [(1, True), (3, True), (8, True),
+                                            (8, False)],
+                         ids=["k1", "k3", "k8", "k8-nobuf"])
+def test_chunked_serving_token_identical(served_model, chunk, buffered):
+    """Greedy chunked serving (any K, double-buffered or not) is
+    token-identical to the per-step engine and to sequential `generate()`
+    on a mixed-length trace — the acceptance contract for the multi-token
+    serving step.  The host syncs once per chunk, not per token."""
+    cfg, params = served_model
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+               for n in (3, 9, 17, 5, 33)]
+    max_news = [8, 12, 6, 10, 7]
+    want = _sequential_greedy(cfg, params, prompts, max_news)
+
+    def run(k, buf):
+        engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+            block_size=4, max_batch=3, prefill_chunk=8,
+            decode_chunk=k, double_buffer=buf,
+        )
+        for i, (p, m) in enumerate(zip(prompts, max_news)):
+            engine.add_request(f"r{i}", p, m)
+        return engine.run()
+
+    results, stats = run(chunk, buffered)
+    per_step, ps_stats = run(1, False)
+    for i in range(len(prompts)):
+        assert results[f"r{i}"] == want[i], f"r{i} diverged from generate()"
+        assert results[f"r{i}"] == per_step[f"r{i}"]
+    if chunk > 1:
+        # the amortization is real: strictly fewer host reads than the
+        # per-step engine for the same token count
+        assert stats.host_syncs < ps_stats.host_syncs
+        assert stats.tokens_per_sync > ps_stats.tokens_per_sync
+
+
+def test_chunked_stop_sequence_mid_chunk(served_model):
+    """Stops landing mid-chunk must truncate exactly where the per-step
+    engine stops — single-token stops (masked on device) and multi-token
+    stops (detected host-side between chunks) alike; the extra computed
+    tokens are discarded without perturbing any other slot."""
+    cfg, params = served_model
+    prompt = [9, 9, 4]
+    free = _sequential_greedy(cfg, params, [prompt], [16])[0]
+    gen_tail = free[len(prompt):]
+    stop1 = [[gen_tail[4]]]           # 5th generated token, single-token stop
+    stop2 = [gen_tail[6:8]]           # multi-token stop spanning positions 7-8
+    want = _sequential_greedy(
+        cfg, params, [prompt, prompt, prompt], [16, 16, 16],
+        stops=[stop1, stop2, ()],
+    )
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=3, decode_chunk=8
+    )
+    engine.add_request("s1", prompt, 16, stop_sequences=stop1)
+    engine.add_request("s2", prompt, 16, stop_sequences=stop2)
+    engine.add_request("free", prompt, 16)
+    results, _ = engine.run()
+    assert results["s1"] == want[0]
+    assert results["s2"] == want[1]
+    assert results["free"] == want[2]
+    assert engine.pool.used == 0  # mid-chunk retirement released everything
+
+
+def _cycling_prompts(cfg, seeds):
+    """Prompts whose greedy continuation echoes earlier context (the tiny
+    random model falls into cycles), so n-gram drafting genuinely fires."""
+    return [np.random.default_rng(s).integers(1, cfg.vocab_size, 5).tolist()
+            for s in seeds]
+
+
+@pytest.mark.parametrize("spec_k,chunk", [(4, 1), (4, 4), (8, 8)])
+def test_speculative_serving_token_identical(served_model, spec_k, chunk):
+    """Batched speculative serving (per-slot n-gram drafts, ONE ragged
+    verify forward over the paged cache) is token-identical to sequential
+    greedy `generate()` — and actually accepts drafts (the prompts cycle,
+    the regime prompt-lookup targets)."""
+    cfg, params = served_model
+    prompts = _cycling_prompts(cfg, (5, 7, 0))
+    max_news = [40, 35, 30]
+    want = _sequential_greedy(cfg, params, prompts, max_news)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=3, decode_chunk=chunk, spec_k=spec_k,
+    )
+    for i, (p, m) in enumerate(zip(prompts, max_news)):
+        engine.add_request(f"r{i}", p, m)
+    results, stats = engine.run()
+    for i in range(len(prompts)):
+        assert results[f"r{i}"] == want[i], f"r{i} diverged under spec_k"
+    assert stats.spec_drafted > 0, "trace was built to draft"
+    assert stats.spec_accepted > 0, "cycling continuations must accept"
+    assert 0.0 < stats.spec_accept_rate <= 1.0
+    assert engine.pool.used == 0
+
+
+def test_speculative_mixed_batch_with_non_drafting_slot(served_model):
+    """A slot whose context never echoes rides the same ragged verify with
+    one valid token (q_len 1) while its neighbors verify K+1 — per-slot
+    raggedness end to end, outputs all exact."""
+    cfg, params = served_model
+    rng = np.random.default_rng(11)
+    prompts = _cycling_prompts(cfg, (5,)) + [
+        rng.integers(1, cfg.vocab_size, 23).tolist()  # non-echoing
+    ]
+    max_news = [30, 12]
+    want = _sequential_greedy(cfg, params, prompts, max_news)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, decode_chunk=4, spec_k=4,
+    )
+    for i, (p, m) in enumerate(zip(prompts, max_news)):
+        engine.add_request(f"r{i}", p, m)
+    results, stats = engine.run()
+    for i in range(len(prompts)):
+        assert results[f"r{i}"] == want[i]
+    assert stats.spec_drafted > 0
+
+
+def test_spec_draft_capped_by_budget_on_tight_pool(served_model):
+    """A near-budget slot must trim its draft so the verify reservation
+    never exceeds the blocks_needed(prompt+max_new) worst case admission
+    guaranteed: on a pool sized exactly to that worst case, an uncapped
+    K=8 draft would demand coverage no preemption can free and the lone
+    sequence would self-preempt/resume forever."""
+    cfg, params = served_model
+    rng = np.random.default_rng(2)
+    rep = rng.integers(1, cfg.vocab_size, 6).tolist()
+    prompt = rep * 4  # the prompt itself echoes, so drafting fires at once
+    total = len(prompt) + 2  # remaining budget 2 -> draft capped to 1
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=1, max_blocks=1 + -(-total // 4),
+        prefix_caching=False, decode_chunk=1, spec_k=8,
+    )
+    engine.add_request("t", prompt, 2)
+    for _ in range(64):  # bounded: a reservation livelock must FAIL, not hang
+        if not engine.scheduler.has_work or not engine.step():
+            break
+    else:
+        pytest.fail("engine made no progress (speculative reservation livelock)")
+    want = _sequential_greedy(cfg, params, [prompt], [2])[0]
+    assert engine._results["t"] == want
+    assert engine.scheduler.preemptions == 0  # fit without self-preempting
+
+
+def test_speculative_requires_greedy(served_model):
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="temperature"):
+        gen.serve(spec_k=4, temperature=0.7)
+    with pytest.raises(ValueError, match="decode_chunk"):
+        gen.serve(decode_chunk=0)
+
+
+def test_shared_fn_cache_does_not_pin_dead_engines(served_model):
+    """Compiled serving fns live on the Generator (so a warmup engine and
+    its timed twin share one jit cache — zero re-traces), but the closures
+    must not capture the engine: a pinned engine keeps its ENTIRE paged
+    pool alive for the Generator's lifetime."""
+    import gc
+    import weakref
+
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    warm = gen.serve(block_size=4, max_batch=2, decode_chunk=4)
+    warm.add_request("w", [5, 6, 7], 4)
+    warm.run()
+    ref = weakref.ref(warm)
+    timed = gen.serve(block_size=4, max_batch=2, decode_chunk=4)
+    assert timed._fns is warm._fns  # one cache, no re-trace for the twin
+    del warm
+    gc.collect()
+    assert ref() is None, "serving fn cache pinned the dead engine (and pool)"
+
+
+def test_persistent_table_zeroes_released_slots(served_model):
+    """The incrementally-maintained block table must zero a retired slot's
+    row before the next dispatch: a stale row would route a dead lane's
+    position-0 write into a released (possibly prefix-cached) block."""
+    cfg, params = served_model
+    rng = np.random.default_rng(3)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, decode_chunk=4
+    )
+    engine.add_request("a", rng.integers(1, cfg.vocab_size, 9).tolist(), 6)
+    engine.add_request("b", rng.integers(1, cfg.vocab_size, 9).tolist(), 14)
+    results, _ = engine.run()
+    assert set(results) == {"a", "b"}
+    # after the run every slot is empty; a fresh sync must be all-trash
+    tables = engine._sync_tables([])
+    assert not tables.any(), "released slots left stale block ids in the table"
 
 
 def test_resumed_prefill_registers_only_fed_blocks(served_model):
@@ -325,21 +549,28 @@ def test_engine_rejects_meshed_generator(served_model, devices):
 
 @pytest.mark.slow
 def test_bench_serving_row_cpu_fallback():
-    """The `serving-cb` bench row end-to-end on the CPU backend: must
-    report tokens/s and KV-block utilization (the acceptance criterion
-    for the suite row)."""
+    """The `serving-cb` bench row end-to-end on the CPU backend (through
+    run_direct, so the CompileGuard wraps it): must report tokens/s,
+    KV-block utilization, tokens_per_sync >= decode_chunk on a loaded
+    batch, and ZERO post-warmup recompiles — the acceptance criteria for
+    the suite row."""
     import bench
 
     ap = bench.build_parser()
     args = ap.parse_args(
         ["--direct", "--mode", "serve", "--model", "pythia-14m",
-         "--batch", "2", "--seq-len", "128", "--new-tokens", "8",
-         "--serve-requests", "4", "--serve-block-size", "8"]
+         "--batch", "4", "--seq-len", "128", "--new-tokens", "24",
+         "--serve-requests", "8", "--serve-block-size", "8",
+         "--serve-chunk", "8"]
     )
-    out = bench.run_serve(args)
+    out = bench.run_direct(args)
     assert out["unit"] == "tokens/s/chip"
     assert out["value"] > 0
     d = out["detail"]
-    assert d["requests"] == 4
+    assert d["requests"] == 8
     assert 0.0 < d["kv_block_utilization_peak"] <= 1.0
     assert d["tokens_generated"] > 0
+    assert d["host_syncs"] > 0
+    assert d["tokens_per_sync"] >= 8, "chunked serving must amortize syncs"
+    assert d["compiles"]["traces_after_warmup"] == 0
+    assert d["compiles"]["backend_compiles_after_warmup"] == 0
