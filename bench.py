@@ -81,11 +81,12 @@ def build_parser():
                     help="suite mode: stop launching new rows after this many seconds")
     ap.add_argument("--rows", default=None,
                     help="suite mode: comma-separated row names to run (default all)")
-    # Probe budget: BENCH_r05 burned 900 s of a 1140 s suite on two probe
-    # timeouts before the CPU fallback — the default is now small (one
-    # retry, 180 s per attempt) and env-overridable for sessions that KNOW
-    # the tunnel needs a long bring-up (MDI_BENCH_PROBE_TIMEOUT /
-    # MDI_BENCH_PROBE_RETRIES mirror the flags for driver-run suites).
+    # Probe budget: BENCH_r05 burned 900 s of a 1140 s suite on probe
+    # timeouts before the CPU fallback — the budget is a HARD TOTAL cap
+    # (180 s across every attempt AND retry sleep, not per attempt) and
+    # env-overridable for sessions that KNOW the tunnel needs a long
+    # bring-up (MDI_BENCH_PROBE_TIMEOUT / MDI_BENCH_PROBE_RETRIES mirror
+    # the flags for driver-run suites).
     def _env_num(name, cast, fallback):
         # a malformed env value must degrade to the default, not kill every
         # bench invocation at parser construction
@@ -98,8 +99,10 @@ def build_parser():
 
     ap.add_argument("--probe-timeout", type=float,
                     default=_env_num("MDI_BENCH_PROBE_TIMEOUT", float, 180.0),
-                    help="suite mode: per-attempt backend probe timeout (s); "
-                    "env MDI_BENCH_PROBE_TIMEOUT overrides the default")
+                    help="suite mode: HARD TOTAL probe budget (s) across all "
+                    "attempts and retry sleeps — the CPU fallback starts the "
+                    "moment it expires; env MDI_BENCH_PROBE_TIMEOUT overrides "
+                    "the default")
     ap.add_argument("--probe-retries", type=int,
                     default=_env_num("MDI_BENCH_PROBE_RETRIES", int, 1),
                     help="suite mode: probe attempts AFTER the first (each "
@@ -153,6 +156,11 @@ def build_parser():
     ap.add_argument("--serve-chunk", type=int, default=8,
                     help="serve mode: device decode steps per host sync "
                     "(ServingConfig.decode_chunk; 1 = per-step engine)")
+    ap.add_argument("--serve-token-budget", type=int, default=None,
+                    help="serve mode: unified-step token budget "
+                    "(ServingConfig.token_budget; decode lanes + prefill "
+                    "chunk tokens per mixed dispatch; default "
+                    "max_batch + prefill_chunk)")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="serve mode: n-gram speculative draft length "
                     "(greedy only; 0 disables)")
@@ -211,8 +219,11 @@ def run_preflight(args, cfg, mode):
             decode_chunk=args.serve_chunk,
             spec_k=args.spec_k,
             double_buffer=not args.no_double_buffer,
+            token_budget=args.serve_token_budget,
         )
-        act_t = min(_bucket(max(1, min(128, args.seq_len // 2))), seq_len)
+        # the widest live token axis of a serving dispatch is the unified
+        # mixed step's static packed width (prompt lengths can't perturb it)
+        act_t = serving.resolved_token_budget()
     else:
         total_max = args.prompt_len + (1 if mode == "prefill" else args.new_tokens)
         act_t = min(_bucket(args.prompt_len), seq_len)
@@ -509,15 +520,17 @@ def run_serve(args):
             decode_chunk=args.serve_chunk,
             spec_k=args.spec_k,
             double_buffer=not args.no_double_buffer,
+            token_budget=args.serve_token_budget,
         )
 
     trace = synthetic_trace(
         n_requests, cfg.vocab_size, args.seq_len, args.new_tokens
     )
-    # warmup on the FULL trace with tiny budgets: every prefill bucket the
-    # timed run will hit compiles here (prompt-derived, budget-independent),
-    # plus the fixed (B, decode_chunk) scan and, with spec_k, the verify
-    # width — so the timed run below reports zero post-warmup recompiles
+    # warmup on the FULL trace with tiny budgets: the serving executables
+    # are all prompt-independent now — ONE (1, token_budget) unified mixed
+    # step (no per-prompt-bucket prefill fns), the fixed (B, decode_chunk)
+    # scan, and, with spec_k, the verify width — so the timed run below
+    # reports zero post-warmup recompiles
     warm = build_engine()
     for rid, prompt, new in trace:
         warm.add_request(
@@ -549,8 +562,11 @@ def run_serve(args):
             "requests": stats.requests_finished,
             "wall_s": round(wall, 2),
             "decode_steps": stats.decode_steps,
+            "mixed_steps": stats.mixed_steps,
             "host_syncs": stats.host_syncs,
             "tokens_per_sync": round(stats.tokens_per_sync, 2),
+            "padded_token_frac": round(stats.padded_token_frac, 4),
+            "mixed_batch_occupancy": round(stats.mixed_batch_occupancy, 4),
             "spec_accept_rate": round(stats.spec_accept_rate, 4),
             "prefill_chunks": stats.prefill_chunks,
             "kv_block_utilization_mean": round(stats.kv_utilization_mean, 4),
@@ -562,6 +578,7 @@ def run_serve(args):
             "config": {
                 "model": args.model, "slots": args.batch,
                 "block_size": args.serve_block_size,
+                "token_budget": engine.token_budget,  # resolved, not the flag
                 "decode_chunk": args.serve_chunk, "spec_k": args.spec_k,
                 "double_buffer": not args.no_double_buffer,
                 "scan_unroll": args.scan_unroll,
@@ -867,12 +884,22 @@ def run_suite(args):
         print(f"bench: {msg}", file=sys.stderr, flush=True)
 
     # --- backend bring-up with retry-after-sleep in fresh interpreters ---
-    # default budget is deliberately small (see --probe-timeout): a wedged
-    # tunnel fails in minutes and falls to CPU instead of eating the suite
+    # --probe-timeout is a HARD TOTAL cap, not a per-attempt window:
+    # BENCH_r05 burned 900 s of a 1140 s suite because each attempt got the
+    # full budget again (events showed attempts still starting at t=420 s
+    # and t=900 s).  Every attempt now runs against the REMAINING budget,
+    # retry sleeps draw from the same budget, and the CPU fallback starts
+    # the moment the deadline passes — whatever --probe-retries says.
     tpu_ok = False
+    probe_deadline = time.perf_counter() + args.probe_timeout
     attempts = max(1, args.probe_retries + 1)
     for attempt in range(attempts):
-        res, err = _child(["--probe"], timeout=args.probe_timeout)
+        remaining = probe_deadline - time.perf_counter()
+        if remaining <= 0:
+            note(f"probe budget ({args.probe_timeout:g}s total) exhausted; "
+                 "falling back")
+            break
+        res, err = _child(["--probe"], timeout=remaining)
         det = (res or {}).get("detail", {})
         # the tunnel plugin may report its platform as "tpu" or "axon"
         if res is not None and (
@@ -885,11 +912,13 @@ def run_suite(args):
         # hung probes usually mean a wedged tunnel and further probes just
         # queue behind it — that risk is priced into the SMALL DEFAULT
         # budget; a raised --probe-retries is honored uniformly (timeouts
-        # included) up to the suite-budget/3 ceiling below, which caps how
-        # much of the suite probing may ever consume
-        if elapsed() > args.suite_budget / 3 or attempt == attempts - 1:
+        # included) but can never stretch the TOTAL beyond --probe-timeout
+        # or the suite-budget/3 ceiling below
+        remaining = probe_deadline - time.perf_counter()
+        if (remaining <= 0 or elapsed() > args.suite_budget / 3
+                or attempt == attempts - 1):
             break  # no sleep after the final attempt: go straight to fallback
-        time.sleep(60)
+        time.sleep(min(60.0, remaining))
 
     selected = None if not args.rows else set(args.rows.split(","))
 

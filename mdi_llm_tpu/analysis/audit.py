@@ -98,6 +98,10 @@ AUDIT_RULES: Dict[str, Tuple[str, str]] = {
         "bubbles (paper invariant, MDI-LLM README)"),
     "bad-serving-config": (
         ERROR, "the paged-KV ServingConfig cannot be instantiated"),
+    "bad-token-budget": (
+        ERROR, "the unified serving step's token budget cannot fit one "
+        "decode token per max_batch slot plus any prefill chunk token "
+        "(prefill could never progress)"),
 }
 
 GiB = float(1 << 30)
@@ -682,6 +686,23 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
         )
     for p in problems:
         findings.append(_finding(plan, "bad-serving-config", p))
+    # unified-step token budget: the mixed batch packs one decode token per
+    # live slot FIRST, then prefill chunk tokens — a budget at or below
+    # max_batch starves prefill forever (the engine refuses it too).  The
+    # budget never changes the pool geometry, so the pool-byte estimates
+    # below stay byte-exact vs the live engine whatever it is.
+    if sv.max_batch >= 1 and sv.prefill_chunk >= 0:
+        budget = sv.resolved_token_budget()
+        if budget <= sv.max_batch:
+            suggested = sv.max_batch + max(1, sv.prefill_chunk)
+            findings.append(_finding(
+                plan, "bad-token-budget",
+                f"token_budget={budget} <= max_batch={sv.max_batch}: every "
+                "unified step packs one decode token per live slot before "
+                "any prefill token, so this budget leaves prefill zero "
+                f"room; set token_budget >= {suggested} (max_batch + "
+                "prefill_chunk) or leave it None for that default",
+            ))
     if sv.block_size >= 1:
         breakdown["kv_pool"] = {
             "num_blocks": n_blocks,
@@ -690,6 +711,7 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             "decode_chunk": sv.decode_chunk,
             "spec_k": sv.spec_k,
             "reserve_headroom_blocks": headroom,
+            "token_budget": sv.resolved_token_budget(),
         }
 
 
@@ -854,6 +876,9 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--max-blocks", type=int, default=None)
     srv.add_argument("--max-batch", type=int, default=8)
     srv.add_argument("--prefill-chunk", type=int, default=128)
+    srv.add_argument("--token-budget", type=int, default=None,
+                     help="unified-step token budget (default: max_batch + "
+                     "prefill_chunk)")
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget (e.g. 16 for v5e)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
@@ -920,6 +945,7 @@ def _plan_from_args(args) -> PlanSpec:
             max_blocks=args.max_blocks,
             max_batch=args.max_batch,
             prefill_chunk=args.prefill_chunk,
+            token_budget=args.token_budget,
         )
     return PlanSpec(
         cfg=cfg,

@@ -3,7 +3,8 @@
 Feeds a request trace — prompts from a file (one per line), a repeated
 ``--prompt``, or a mixed-length synthetic trace — through
 `ServingEngine` (`serving/engine.py`): request-level scheduling over a
-shared block pool, chunked prefill interleaved with batched decode,
+shared block pool, a unified token-budget step (every decode lane's
+pending token + prefill chunks in ONE ragged forward per dispatch),
 mid-batch retirement, hash-based prefix caching.  Prints each finished
 request (decoded when a tokenizer is available) and a one-line JSON stats
 summary: tokens/s, KV-block utilization, prefix-cache hits, preemptions.
@@ -57,7 +58,13 @@ def build_parser():
     ap.add_argument("--max-batch", type=int, default=8,
                     help="concurrent decode slots")
     ap.add_argument("--prefill-chunk", type=int, default=128,
-                    help="max prompt tokens per prefill dispatch")
+                    help="max prompt tokens one sequence feeds per step")
+    ap.add_argument("--token-budget", type=int, default=None,
+                    help="unified-step token budget: every engine step runs "
+                    "ONE ragged forward packing each decode lane's pending "
+                    "token plus prefill chunk tokens up to this width "
+                    "(prompts longer than the leftover split across steps); "
+                    "default max_batch + prefill-chunk")
     ap.add_argument("--decode-chunk", type=int, default=8,
                     help="device decode steps per host sync: K steps run as "
                     "one on-device lax.scan and the host reads tokens once "
@@ -128,6 +135,7 @@ def main(argv=None):
         max_blocks=args.max_blocks,
         max_batch=args.max_batch,
         prefill_chunk=args.prefill_chunk,
+        token_budget=args.token_budget,
         decode_chunk=args.decode_chunk,
         spec_k=args.spec_k,
         double_buffer=not args.no_double_buffer,
@@ -208,8 +216,11 @@ def main(argv=None):
         "tokens_per_s": round(stats.tokens_per_s, 2),
         "wall_s": round(stats.wall_s, 2),
         "decode_steps": stats.decode_steps,
+        "mixed_steps": stats.mixed_steps,
         "host_syncs": stats.host_syncs,
         "tokens_per_sync": round(stats.tokens_per_sync, 2),
+        "padded_token_frac": round(stats.padded_token_frac, 4),
+        "mixed_batch_occupancy": round(stats.mixed_batch_occupancy, 4),
         "spec_accept_rate": round(stats.spec_accept_rate, 4),
         "prefill_chunks": stats.prefill_chunks,
         "kv_block_utilization_mean": round(stats.kv_utilization_mean, 4),

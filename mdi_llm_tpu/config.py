@@ -447,8 +447,16 @@ class ServingConfig:
     # (1 trash block + max_batch × ceil(max_seq_length / block_size))
     # scheduling --------------------------------------------------------------
     max_batch: int = 8  # concurrent decode slots (jit batch shape)
-    prefill_chunk: int = 128  # max prompt tokens per prefill dispatch
+    prefill_chunk: int = 128  # max prompt tokens one sequence feeds per step
     prefix_caching: bool = True  # hash-chain block reuse for shared prompts
+    token_budget: Optional[int] = None  # unified-step token budget: the
+    # mixed ragged batch packs every decode lane's pending token FIRST,
+    # then prefill chunk tokens into the remainder, all in ONE forward of
+    # static width `token_budget` (Sarathi-style composition; prompts
+    # longer than the leftover split across steps).  None → max_batch +
+    # prefill_chunk (every lane plus one full chunk).  Must exceed
+    # max_batch or prefill could never progress (mdi-audit:
+    # bad-token-budget)
     # decode dispatch ---------------------------------------------------------
     decode_chunk: int = 8  # device decode steps per host sync (lax.scan):
     # the host reads tokens once per K steps instead of per token, so the
@@ -468,6 +476,17 @@ class ServingConfig:
     # attention backend: None → auto (Pallas kernel on TPU decode steps,
     # exact lax gather fallback elsewhere — tier-1 CPU tests use the latter)
     use_kernel: Optional[bool] = None
+
+    def resolved_token_budget(self) -> int:
+        """The unified serving step's per-dispatch token-axis width: every
+        decode lane's pending token plus the prefill tokens that fit.
+        `token_budget` when set, else max_batch + prefill_chunk — so the
+        default always serves a full decode batch alongside one full
+        prefill chunk.  Shared by the engine (the `_mixed_fn` compile
+        shape) and the mdi-audit `bad-token-budget` checker."""
+        if self.token_budget is not None:
+            return int(self.token_budget)
+        return self.max_batch + max(1, self.prefill_chunk)
 
     def num_pool_blocks(self, max_seq_length: int) -> int:
         """Pool size in blocks: `max_blocks` when set, else full coverage

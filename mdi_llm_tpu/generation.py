@@ -924,8 +924,9 @@ class Generator:
 
     def serve(self, serving=None, **knobs):
         """A paged-KV continuous-batching engine bound to this model
-        (serving/engine.py): request queue, chunked prefill interleaved
-        with batched decode, mid-batch retirement, prefix-cached blocks.
+        (serving/engine.py): request queue, unified token-budget steps
+        (decode lanes + prefill chunks in ONE ragged forward per
+        dispatch), mid-batch retirement, prefix-cached blocks.
 
         Pass a `ServingConfig`, or its fields as keywords::
 

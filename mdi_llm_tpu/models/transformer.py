@@ -194,6 +194,10 @@ def attention_forward(
     # tables: k/v caches are the POOLED (num_blocks, block_size, G, hs)
     # layout and reads/writes resolve through the table (serving engine)
     paged_kernel: Optional[bool] = None,  # None → auto (TPU, decode step)
+    paged_ragged: Optional[Tuple] = None,  # unified serving step: (q_slot
+    # (T,), q_start (n_slots,), q_len (n_slots,)) — B == 1, tokens packed
+    # slot-major, `paged_tables` is (n_slots, max_blocks) and every token
+    # resolves reads/writes through its OWN slot's table row at `pos`
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     B, T, D = x.shape
     qkv = linear(x, p["qkv"])
@@ -216,17 +220,37 @@ def attention_forward(
 
     if paged_tables is not None:
         # serving path: pooled block cache, reads/writes through the table
-        from mdi_llm_tpu.ops.paged_attention import paged_attention, paged_update
+        from mdi_llm_tpu.ops.paged_attention import (
+            paged_attention,
+            paged_prefill,
+            paged_update,
+        )
 
         if k_cache is None:
             raise ValueError("paged attention requires the pooled KV cache")
-        k_cache, v_cache = paged_update(
-            k_cache, v_cache, k.swapaxes(1, 2), v.swapaxes(1, 2),
-            paged_tables, pos,
-        )
-        y = paged_attention(
-            q, k_cache, v_cache, paged_tables, pos, use_kernel=paged_kernel
-        )
+        if paged_ragged is not None:
+            # unified mixed step: packed slot-major tokens, B == 1.  Each
+            # token is one lane of the batched update with its OWN slot's
+            # table row; packed-tail padding carries a position past the
+            # table's coverage, so its write lands in the trash block
+            q_slot, q_start, q_len = paged_ragged
+            k_cache, v_cache = paged_update(
+                k_cache, v_cache,
+                k.swapaxes(1, 2)[0][:, None], v.swapaxes(1, 2)[0][:, None],
+                paged_tables[q_slot], pos[0][:, None],
+            )
+            y = paged_prefill(
+                q, k_cache, v_cache, paged_tables, q_slot, q_start, q_len,
+                pos[0], use_kernel=paged_kernel,
+            )
+        else:
+            k_cache, v_cache = paged_update(
+                k_cache, v_cache, k.swapaxes(1, 2), v.swapaxes(1, 2),
+                paged_tables, pos,
+            )
+            y = paged_attention(
+                q, k_cache, v_cache, paged_tables, pos, use_kernel=paged_kernel
+            )
         y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size)
         return linear(y.astype(x.dtype), p["proj"]), k_cache, v_cache
 
@@ -331,6 +355,7 @@ def block_forward(
     collect_moe_aux: bool = False,
     paged_tables: Optional[jnp.ndarray] = None,
     paged_kernel: Optional[bool] = None,
+    paged_ragged: Optional[Tuple] = None,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
     parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms.
@@ -342,6 +367,7 @@ def block_forward(
         cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis,
         fresh_prefill, use_flash, sp_meta,
         paged_tables=paged_tables, paged_kernel=paged_kernel,
+        paged_ragged=paged_ragged,
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
@@ -382,6 +408,7 @@ def run_blocks(
     collect_moe_aux: bool = False,
     paged_tables: Optional[jnp.ndarray] = None,
     paged_kernel: Optional[bool] = None,
+    paged_ragged: Optional[Tuple] = None,
 ):
     # returns (x, kv), or (x, kv, aux_sum) under collect_moe_aux
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
@@ -436,6 +463,7 @@ def run_blocks(
             fresh_prefill=fresh_prefill, use_flash=use_flash, sp_meta=sp_meta,
             moe_impl=moe_impl,
             paged_tables=paged_tables, paged_kernel=paged_kernel,
+            paged_ragged=paged_ragged,
         )
         return y, (k_c, v_c)
 
@@ -456,7 +484,9 @@ def embed(cfg: Config, params: Params, tokens: jnp.ndarray, pos: jnp.ndarray) ->
     if cfg.scale_embeddings:  # Gemma (model.py:390-391)
         x = x * jnp.asarray(cfg.n_embd**0.5, dtype=x.dtype)
     if cfg.pos_embedding == "learned":
-        x = x + jnp.take(params["wpe"]["weight"], pos, axis=0)
+        # mode="clip": see forward()'s rope gather — padding positions past
+        # the table must clip, not NaN-fill (0 * NaN poisons masked reads)
+        x = x + jnp.take(params["wpe"]["weight"], pos, axis=0, mode="clip")
     return x
 
 
@@ -488,6 +518,7 @@ def forward(
     collect_moe_aux: bool = False,
     paged_tables: Optional[jnp.ndarray] = None,
     paged_kernel: Optional[bool] = None,
+    paged_ragged: Optional[Tuple] = None,
 ):
     # returns (logits, kv), or (logits, kv, aux_sum) under collect_moe_aux
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
@@ -503,7 +534,12 @@ def forward(
 
     With `paged_tables` (serving engine), `kv` is the POOLED block cache
     from `init_paged_kv_cache` and every read/write resolves through the
-    per-sequence block tables (ops/paged_attention.py).
+    per-sequence block tables (ops/paged_attention.py).  With
+    `paged_ragged` (the unified mixed serving step), `tokens` is a (1, T)
+    slot-major PACKED ragged batch — pass `input_pos` as the (1, T)
+    per-token absolute positions (a 2-D `input_pos` overrides the
+    contiguous-chunk ramp) and `paged_tables` as the full
+    (n_slots, max_blocks) table.
 
     `fresh_prefill` (caller contract: input_pos == 0, cache empty) attends
     over the chunk itself rather than the cache buffer, enabling the Pallas
@@ -512,11 +548,19 @@ def forward(
     also composes with `remat`/`jax.grad` for training.
     """
     B, T = tokens.shape
-    pos = input_pos[:, None] + jnp.arange(T, dtype=input_pos.dtype)[None, :]
+    if input_pos.ndim == 2:
+        pos = input_pos  # explicit per-token positions (ragged mixed step)
+    else:
+        pos = input_pos[:, None] + jnp.arange(T, dtype=input_pos.dtype)[None, :]
     if rope is None:
         rope = get_rope_cache(cfg)
-    cos = jnp.take(rope[0], pos, axis=0)
-    sin = jnp.take(rope[1], pos, axis=0)
+    # mode="clip" pins the documented out-of-bounds behavior: jnp.take's
+    # default FILLS with NaN, and the ragged mixed step's padding tokens
+    # deliberately carry a position past the table (their K/V goes to the
+    # trash block) — a NaN there would leak through every masked-attention
+    # read as 0 * NaN
+    cos = jnp.take(rope[0], pos, axis=0, mode="clip")
+    sin = jnp.take(rope[1], pos, axis=0, mode="clip")
     x = embed(cfg, params, tokens, pos)
     out = run_blocks(
         cfg, params["blocks"], x, pos, cos, sin, kv, input_pos, remat=remat,
@@ -524,6 +568,7 @@ def forward(
         sp_meta=sp_meta, moe_impl=moe_impl, unroll=unroll,
         collect_moe_aux=collect_moe_aux,
         paged_tables=paged_tables, paged_kernel=paged_kernel,
+        paged_ragged=paged_ragged,
     )
     if collect_moe_aux:
         x, kv, aux_sum = out

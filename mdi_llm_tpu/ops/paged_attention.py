@@ -30,6 +30,12 @@ Two implementations:
   drafted tokens + 1 per slot, every slot at a different depth).  Semantics
   are validated against the fallback in interpreter mode; the fallback
   remains the default off-TPU.
+- **`paged_prefill`**: the unified serving step's ragged mixed
+  prefill+decode attention — every live slot's tokens (decode lanes at 1
+  token, prefill chunks at their fed width) packed slot-major into ONE
+  query axis, per-slot `q_start/q_len/q_pos` scalar-prefetched, causal
+  masking inside each slot's own chunk, one online-softmax row per
+  (head, packed token).  Plus the bit-exact per-token gather fallback.
 
 Writes go through `paged_update`: a scatter of the chunk's K/V into
 `(block, offset)` slots resolved through the table.  Positions past the
@@ -49,6 +55,7 @@ from mdi_llm_tpu.ops.attention import NEG_INF, multihead_attention
 
 __all__ = [
     "paged_attention",
+    "paged_prefill",
     "paged_update",
     "gather_paged_kv",
     "RAGGED_KERNEL_MAX_TQ",
@@ -322,6 +329,255 @@ def _paged_attention_ragged_kernel(
         interpret=interpret,
     )(tables, lens, q_pos.astype(jnp.int32), q, k_pool, v_pool)
     return out
+
+
+def _ragged_prefill_kernel(
+    # scalar prefetch (per SLOT, not per token — the whole point of the
+    # packed layout is that slot metadata is O(slots), not O(tokens))
+    tables_ref,  # (S, MB) int32
+    qstart_ref,  # (S,) int32 — offset of slot s's query span in the packed axis
+    qlen_ref,  # (S,) int32 — span length (0 = slot absent this step)
+    qpos0_ref,  # (S,) int32 — absolute position of the span's FIRST token
+    # blocks
+    q_ref,  # (1, n_head, T, hs) — the whole packed batch rides every step
+    k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
+    v_ref,
+    o_ref,  # (1, n_head, T, hs)
+    # scratch: every (head, packed token) pair is one online-softmax row
+    m_ref,  # (n_head * T, 128) f32 running max (lane-broadcast scalar)
+    l_ref,  # (n_head * T, 128) f32 running denominator
+    acc_ref,  # (n_head * T, hs) f32 running numerator
+    *,
+    block_size: int,
+    n_groups: int,
+    n_tokens: int,
+    scale: float,
+):
+    # Known tradeoff: every grid step scores the WHOLE packed q against the
+    # step's kv block and masks rows outside the current slot's span, so
+    # ~(1 - 1/n_live_slots) of each matmul is discarded.  The static shapes
+    # keep the kernel one compile and the scratch layout trivial; if this
+    # waste ever shows up on profiles, the fix is a q-tile grid axis with a
+    # host-computed tile->slot map in scalar prefetch so each step's matmul
+    # covers only one slot's span.
+    s_id = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(s_id == 0, i == 0))
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = qstart_ref[s_id]
+    q_len = qlen_ref[s_id]
+    q_pos0 = qpos0_ref[s_id]
+    n_live = q_pos0 + q_len  # KV slots visible to the span's deepest query
+
+    @pl.when(jnp.logical_and(q_len > 0, i * block_size < n_live))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (n_head, T, hs)
+        n_head, T, hs = q.shape
+        q_per_kv = n_head // n_groups
+        k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
+        v = v_ref[0].astype(jnp.float32)
+        qg = q.reshape(n_groups, q_per_kv * T, hs)
+        s = jax.lax.dot_general(
+            qg,
+            k.transpose(1, 2, 0),  # (G, hs, BS)
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s.reshape(n_head, T, block_size)
+        # the slot owns packed rows [q_start, q_start + q_len); its spans are
+        # contiguous position runs, so token t's absolute position is
+        # q_pos0 + (t - q_start) — causal masking inside the slot's own
+        # chunk falls out of the one rule: key at j valid iff j <= q_pos[t]
+        t_idx = jax.lax.broadcasted_iota(jnp.int32, (1, T, 1), 1)
+        in_span = jnp.logical_and(t_idx >= q_start, t_idx < q_start + q_len)
+        qpos = q_pos0 + (t_idx - q_start)
+        jpos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2
+        )
+        s = jnp.where(jnp.logical_and(in_span, jpos <= qpos), s, NEG_INF)
+        s = s.reshape(n_head * T, block_size)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # (n_head * T, BS)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.reshape(n_groups, q_per_kv * T, block_size),
+            v.transpose(1, 0, 2),  # (G, BS, hs)
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(n_head * T, hs)
+        # rows OUTSIDE this slot's span must keep their state untouched:
+        # NEG_INF is finite, so a fully-masked untouched row would compute
+        # p = exp(NEG_INF - NEG_INF) = 1 and pollute another slot's
+        # accumulator with this slot's V blocks — gate the update per row
+        row = jnp.broadcast_to(
+            in_span.reshape(1, T), (n_head, T)
+        ).reshape(n_head * T, 1)
+        m_ref[...] = jnp.where(
+            row, jnp.broadcast_to(m_new, m_ref.shape), m_ref[...]
+        )
+        l_ref[...] = jnp.where(
+            row, jnp.broadcast_to(l_new, l_ref.shape), l_ref[...]
+        )
+        acc_ref[...] = jnp.where(row, corr * acc_ref[...] + pv, acc_ref[...])
+
+    @pl.when(jnp.logical_and(
+        s_id == pl.num_programs(0) - 1, i == pl.num_programs(1) - 1
+    ))
+    def _finalize():
+        # padding rows no slot owns never accumulate (l == 0): the floor
+        # keeps them finite — garbage by contract, discarded by the caller
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        out = acc_ref[...] / denom
+        n_head_t, hs = out.shape
+        o_ref[0] = out.reshape(
+            n_head_t // n_tokens, n_tokens, hs
+        ).astype(o_ref.dtype)
+
+
+def _paged_prefill_kernel(
+    q, k_pool, v_pool, block_tables, q_start, q_len, q_pos, scale,
+    interpret=False,
+):
+    """q: (1, n_head, T, hs) packed slot-major → (1, n_head, T, hs)."""
+    B, n_head, T, hs = q.shape
+    assert B == 1, "paged_prefill packs every slot into one ragged batch"
+    NB, BS, G, _ = k_pool.shape
+    S, MB = block_tables.shape
+    tables = block_tables.astype(jnp.int32)
+    qstart = q_start.astype(jnp.int32)
+    qlen = q_len.astype(jnp.int32)
+    # the span's first absolute position (spans are contiguous runs); the
+    # clip only guards absent slots, whose q_len == 0 skips all compute
+    qpos0 = q_pos.astype(jnp.int32)[jnp.clip(qstart, 0, T - 1)]
+
+    def kv_index(sidx, i, tables_ref, qstart_ref, qlen_ref, qpos0_ref):
+        # see _paged_attention_kernel: unneeded grid steps remap to block 0
+        needed = jnp.logical_and(
+            qlen_ref[sidx] > 0,
+            i * BS < qpos0_ref[sidx] + qlen_ref[sidx],
+        )
+        return (jnp.where(needed, tables_ref[sidx, i], 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(S, MB),
+        in_specs=[
+            pl.BlockSpec((1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)),
+            pl.BlockSpec((1, BS, G, hs), kv_index),
+            pl.BlockSpec((1, BS, G, hs), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_head * T, 128), jnp.float32),
+            pltpu.VMEM((n_head * T, 128), jnp.float32),
+            pltpu.VMEM((n_head * T, hs), jnp.float32),
+        ],
+    )
+    kern = functools.partial(
+        _ragged_prefill_kernel,
+        block_size=BS, n_groups=G, n_tokens=T, scale=scale,
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, n_head, T, hs), q.dtype),
+        interpret=interpret,
+    )(tables, qstart, qlen, qpos0, q, k_pool, v_pool)
+
+
+# packed tokens per gather in the lax fallback: each lane materializes its
+# slot's full-window KV view, so an unchunked (T, window) gather would be
+# token_budget-fold the old B=1 prefill fallback's footprint (~hundreds of
+# MB per layer per step at TinyLlama scale); lax.map over fixed chunks
+# keeps the transient ∝ chunk while staying exact per row
+_LAX_FALLBACK_CHUNK = 16
+
+
+def _paged_prefill_lax(q, k_pool, v_pool, block_tables, q_slot, q_pos, scale):
+    """Exact fallback: each packed token is one lane of the decode fallback
+    with its OWN slot's table — per-token gather, the dense softmax chain
+    bit-for-bit (the serving engine's greedy parity contract).  Wide packed
+    batches run the same math in fixed-size chunks of the token axis
+    (sequential lax.map) to bound the gathered-KV transient."""
+    qt = q[0].transpose(1, 0, 2)[:, :, None, :]  # (T, n_head, 1, hs)
+    T = qt.shape[0]
+    C = _LAX_FALLBACK_CHUNK
+    if T <= C:
+        out = _paged_attention_lax(
+            qt, k_pool, v_pool, block_tables[q_slot], q_pos[:, None], scale
+        )
+        return out[:, :, 0, :].transpose(1, 0, 2)[None]
+    pad = -T % C
+    # pad rows carry slot 0 / position 0: garbage by contract, sliced off
+    qt_p = jnp.pad(qt, ((0, pad), (0, 0), (0, 0), (0, 0)))
+    slot_p = jnp.pad(q_slot, (0, pad))
+    pos_p = jnp.pad(q_pos, (0, pad))
+
+    def chunk(args):
+        qc, sc, pc = args
+        return _paged_attention_lax(
+            qc, k_pool, v_pool, block_tables[sc], pc[:, None], scale
+        )
+
+    out = jax.lax.map(chunk, (
+        qt_p.reshape(-1, C, *qt.shape[1:]),
+        slot_p.reshape(-1, C),
+        pos_p.reshape(-1, C),
+    ))
+    out = out.reshape(-1, *out.shape[2:])[:T]
+    return out[:, :, 0, :].transpose(1, 0, 2)[None]
+
+
+def paged_prefill(
+    q: jnp.ndarray,  # (1, n_head, T, hs) packed slot-major ragged queries
+    k_pool: jnp.ndarray,  # (num_blocks, block_size, G, hs)
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # (n_slots, max_blocks) int32
+    q_slot: jnp.ndarray,  # (T,) slot id per packed token (fallback path)
+    q_start: jnp.ndarray,  # (n_slots,) span offset per slot (kernel path)
+    q_len: jnp.ndarray,  # (n_slots,) span length (0 = slot absent)
+    q_pos: jnp.ndarray,  # (T,) absolute position per packed token
+    scale: Optional[float] = None,
+    use_kernel: Optional[bool] = None,  # None → auto (TPU backend)
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged mixed prefill+decode attention over the paged pool.
+
+    The unified serving step packs every live slot's tokens — one pending
+    decode token per decoding lane, up to the step's remaining token budget
+    of prompt tokens per prefilling lane — slot-major into ONE (1, T) token
+    axis; each packed token attends through its own slot's block table at
+    its own absolute position.  Slot spans are contiguous position runs, so
+    per-slot (q_start, q_len, first position) fully describe the raggedness
+    — the kernel scalar-prefetches exactly that.  Packed positions no slot
+    owns (batch-tail padding) return garbage rows the caller discards.
+
+    Returns (1, n_head, T, hs).
+    """
+    hs = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (hs**0.5)
+    if use_kernel is None:
+        use_kernel = _HAS_PALLAS and jax.default_backend() == "tpu"
+    if use_kernel and _HAS_PALLAS:
+        return _paged_prefill_kernel(
+            q, k_pool, v_pool, block_tables, q_start, q_len, q_pos, scale,
+            interpret=interpret,
+        )
+    return _paged_prefill_lax(
+        q, k_pool, v_pool, block_tables, q_slot, q_pos, scale
+    )
 
 
 def _paged_attention_kernel(

@@ -5,10 +5,22 @@ where `Generator.generate` allocates one contiguous `[B, S]` cache per call
 and holds the batch shape for the whole run, `ServingEngine` keeps ONE
 pooled block cache (`transformer.init_paged_kv_cache`) shared by every
 in-flight request, admits requests from a queue into `max_batch` decode
-slots, runs chunked prefill interleaved with batched decode, retires
-finished sequences mid-batch, and reuses blocks across requests (including
-copy-free prefix sharing for common prompt heads — chat system prompts,
-`utils/prompts.py` styles).
+slots, serves prefill and decode in ONE unified ragged forward per step,
+retires finished sequences mid-batch, and reuses blocks across requests
+(including copy-free prefix sharing for common prompt heads — chat system
+prompts, `utils/prompts.py` styles).
+
+Unified mixed step (the single-chip analogue of the paper's keep-every-
+resource-busy pipeline): whenever prefill work exists, the scheduler
+composes a token-budget batch — every decode lane's pending token FIRST,
+then prefill chunks split to fit `ServingConfig.token_budget` — and
+`_mixed_fn(B, T_budget)` runs it as ONE forward over the paged pool
+(`ops/paged_attention.paged_prefill`: tokens packed slot-major into a
+static (1, T_budget) axis, per-slot ragged spans, per-token block-table
+resolution).  One dispatch + one host sync serves every lane; an arriving
+prompt no longer stalls the decode lanes behind a B=1 bucket-padded
+prefill, and the only padding is the batch tail (`ServingStats.
+padded_token_frac` measures exactly that).
 
 Greedy parity contract (pinned by tests/test_serving.py): because the
 paged attention op masks strictly by absolute position and its lax
@@ -17,10 +29,11 @@ greedy token streams are identical to sequential `Generator.generate`
 calls — scheduling order, chunking, lane assignment and block placement
 are all invisible to the math.
 
-Device dispatch shapes stay bounded: prefill chunks use the same
-power-of-two buckets as `generation.py` (one compile per bucket) at B=1,
-and decode is a fixed `(max_batch, decode_chunk)` scan (dead lanes ride
-along as padding writing into the pool's trash block).
+Device dispatch shapes stay bounded AND prompt-independent: the mixed
+step is a fixed `(1, token_budget)` packed batch (one compile total — the
+per-prompt-bucket prefill executables are gone), and pure decode is a
+fixed `(max_batch, decode_chunk)` scan (dead lanes ride along as padding
+writing into the pool's trash block).
 
 Host-sync amortization (docs/perf.md "Serving host-sync & speculative"):
 with `decode_chunk=K` the inner loop runs K decode steps in ONE jitted
@@ -48,7 +61,6 @@ import numpy as np
 from mdi_llm_tpu.config import ServingConfig
 from mdi_llm_tpu.generation import (
     Generator,
-    _bucket,
     accept_draft,
     detect_stop_tokens,
     find_eot,
@@ -57,7 +69,6 @@ from mdi_llm_tpu.generation import (
 )
 from mdi_llm_tpu.models import transformer
 from mdi_llm_tpu.ops.sampling import (
-    sample,
     sample_mode,
     sample_traced,
     sampling_operands,
@@ -73,8 +84,21 @@ class ServingStats:
     tokens_generated: int = 0
     prefill_tokens: int = 0
     prefill_chunks: int = 0
+    mixed_steps: int = 0  # unified ragged prefill+decode dispatches
     decode_steps: int = 0  # device decode steps (scan iterations + verifies)
     host_syncs: int = 0  # decode/verify host reads (one per chunk dispatch)
+    # padding accounting: `tokens_dispatched` counts device token-axis
+    # positions computed (mixed-step budget width, decode B×K incl. dead
+    # lanes, verify B×(K+1)); `tokens_useful` counts the positions whose
+    # token actually advanced a stream (prefill feeds, retained decode
+    # steps — frozen post-stop scan steps are padding — and the verify's
+    # pending + ACCEPTED draft rows).  padded_token_frac is their gap —
+    # the MXU waste the unified ragged step exists to shrink.
+    tokens_dispatched: int = 0
+    tokens_useful: int = 0
+    # mixed-batch occupancy: live lanes per unified step / max_batch
+    _occ_sum: float = 0.0
+    _occ_n: int = 0
     spec_drafted: int = 0  # draft tokens scored by speculative verify
     spec_accepted: int = 0  # draft tokens accepted (emitted without a step)
     requests_finished: int = 0
@@ -93,6 +117,30 @@ class ServingStats:
         self._kv_util_sum += util
         self._kv_util_n += 1
         self._kv_util_peak = max(self._kv_util_peak, util)
+
+    def observe_dispatch(self, dispatched: int, useful: int) -> None:
+        self.tokens_dispatched += dispatched
+        self.tokens_useful += useful
+
+    def observe_mixed_occupancy(self, live: int, max_batch: int) -> None:
+        self._occ_sum += live / max(1, max_batch)
+        self._occ_n += 1
+
+    @property
+    def padded_token_frac(self) -> float:
+        """Fraction of dispatched device token positions that carried no
+        real token (batch-tail padding, frozen/dead decode lanes, rejected
+        verify rows) — the padding win of the unified step is this number
+        going DOWN vs the bucket-padded prefill engine."""
+        if not self.tokens_dispatched:
+            return 0.0
+        return 1.0 - self.tokens_useful / self.tokens_dispatched
+
+    @property
+    def mixed_batch_occupancy(self) -> float:
+        """Mean live-lane fraction of the unified mixed steps (slots with a
+        token in the packed batch / max_batch)."""
+        return self._occ_sum / self._occ_n if self._occ_n else 0.0
 
     @property
     def tokens_per_s(self) -> float:
@@ -150,6 +198,16 @@ class ServingEngine:
                 "verify emits greedy successors, so only greedy streams are "
                 "exact (the shared_prefill reproducibility rule)"
             )
+        self.token_budget = serving.resolved_token_budget()
+        if self.token_budget <= serving.max_batch:
+            raise ValueError(
+                f"token_budget {self.token_budget} must exceed max_batch "
+                f"{serving.max_batch}: the unified step packs one decode "
+                "token per live slot FIRST, so a budget at or below "
+                "max_batch leaves no room for any prefill token and "
+                "prefill could never progress (None defaults to "
+                "max_batch + prefill_chunk)"
+            )
         self.max_seq_length = gen.max_seq_length
         # blocks per sequence table: full coverage of the engine window
         self.max_blocks_per_seq = -(-self.max_seq_length // bs)
@@ -197,34 +255,53 @@ class ServingEngine:
 
     # -- compiled phases -----------------------------------------------------
 
-    def _prefill_fn(self, T: int):
-        key_ = ("prefill", T)
+    def _mixed_fn(self, B: int, T: int):
+        """ONE unified forward for the token-budget mixed batch: every
+        decode lane's pending token plus up to the remaining budget of
+        prefill chunk tokens, packed slot-major into a static (1, T) token
+        axis that attends through the shared paged pool
+        (`ops/paged_attention.paged_prefill`).  Returns, per SLOT, the
+        sampled successor of the slot's LAST packed token — the decoded
+        next token for a decode lane, the first output token for a prefill
+        that completed its prompt this step (garbage for absent slots and
+        unfinished prefills; the host uses only what it needs).  This is
+        the only serving executable whose shape the prompts can never
+        perturb: one compile per (max_batch, token_budget)."""
+        key_ = ("mixed", B, T)
         if key_ not in self._fns:
             gen = self.gen
             use_kernel = self.cfg.use_kernel  # no self in the closure: the
             # fn cache outlives this engine (gen._serve_fns) and capturing
             # self would pin its entire paged pool for the Generator's life
 
-            @partial(jax.jit, donate_argnums=(2,))
-            def prefill(params, tokens, kv, tables, pos0, true_len):
+            # float knobs ride as traced operands (see _decode_fn)
+            @partial(
+                jax.jit, donate_argnums=(2,),
+                static_argnames=("mode", "top_k"),
+            )
+            def mixed(params, tokens, kv, tables, pos, q_slot, q_start,
+                      q_len, last_idx, key, temperature, top_p, mode, top_k):
                 logits, kv = transformer.forward(
-                    gen.cfg, params, tokens, pos0, kv=kv, rope=gen.rope,
-                    moe_impl=gen._moe_impl, paged_tables=tables,
-                    paged_kernel=use_kernel,
+                    gen.cfg, params, tokens, pos, kv=kv, rope=gen.rope,
+                    moe_impl=gen._moe_impl, unroll=gen.scan_unroll,
+                    paged_tables=tables, paged_kernel=use_kernel,
+                    paged_ragged=(q_slot, q_start, q_len),
                 )
-                last = jnp.take_along_axis(
-                    logits, (true_len - 1)[:, None, None], axis=1
-                )[:, 0]
-                return last, kv
+                key, sub = jax.random.split(key)
+                nxt = sample_traced(
+                    logits[0, last_idx], sub, temperature, top_p,
+                    mode=mode, top_k=top_k,
+                )
+                return nxt.astype(jnp.int32), kv, key
 
-            self._fns[key_] = prefill
+            self._fns[key_] = mixed
         return self._fns[key_]
 
     def _decode_fn(self, B: int):
         key_ = ("decode", B)
         if key_ not in self._fns:
             gen = self.gen
-            use_kernel = self.cfg.use_kernel  # see _prefill_fn: no self
+            use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
 
             # float knobs ride as traced operands; the cache keys only on
             # (mode, top_k) — a per-request temperature sweep would otherwise
@@ -267,7 +344,7 @@ class ServingEngine:
         key_ = ("decode_chunk", B, K)
         if key_ not in self._fns:
             gen = self.gen
-            use_kernel = self.cfg.use_kernel  # see _prefill_fn: no self
+            use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
 
             # float knobs ride as traced operands (see _decode_fn)
             @partial(
@@ -320,7 +397,7 @@ class ServingEngine:
         key_ = ("verify", B, T)
         if key_ not in self._fns:
             gen = self.gen
-            use_kernel = self.cfg.use_kernel  # see _prefill_fn: no self
+            use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
 
             @partial(jax.jit, donate_argnums=(2,))
             def verify(params, tokens, kv, tables, pos0):
@@ -350,11 +427,6 @@ class ServingEngine:
             stop_sequences=stop_sequences,
         ))
         return rid
-
-    def _table_row(self, seq: SequenceState) -> np.ndarray:
-        row = np.zeros((self.max_blocks_per_seq,), np.int32)
-        row[: len(seq.blocks)] = seq.blocks
-        return row
 
     def _sync_tables(self, live: Sequence[SequenceState]) -> np.ndarray:
         """The persistent (max_batch, max_blocks_per_seq) block table for a
@@ -388,32 +460,65 @@ class ServingEngine:
 
     # -- execution -----------------------------------------------------------
 
-    def _run_prefill(self, seq: SequenceState, chunk: int) -> None:
+    def _run_mixed(self, entries: List[Tuple[SequenceState, int]]) -> None:
+        """ONE unified ragged forward serving every lane: the scheduler's
+        token-budget batch packs each decode lane's pending token and each
+        prefilling lane's next chunk slot-major into a static
+        (1, token_budget) axis; every packed token reads/writes the pool
+        through its own slot's table row at its own absolute position
+        (`paged_prefill`), the batch tail pads with trash-block writes.
+        One dispatch, one host sync, no bucket-padded B=1 prefill.
+
+        Per-sequence math is untouched by the packing: each token attends
+        only its own slot's table, so decode streams and prefill logits
+        are bit-identical to the dedicated dispatches they replace — the
+        greedy parity contract carries over unchanged."""
         t0 = time.perf_counter()
-        bs = self.pool.block_size
-        # grow the table to cover this chunk's writes (admission already
-        # reserved enough blocks, so alloc can only fail after preemptions
-        # shrank the pool guarantee — grow defensively like decode does)
-        while self.pool.blocks_needed(seq.fed + chunk) > len(seq.blocks):
-            got = self.pool.alloc(1)
-            if got is None:
-                if not self.scheduler.preempt_latest(exclude=seq):
-                    raise RuntimeError("KV pool exhausted during prefill")
-                if self.scheduler.slots[seq.slot] is not seq:
-                    return  # self-preempted; it will resume from the queue
-                continue
-            seq.blocks.extend(got)
-        Tb = min(_bucket(chunk), self.max_seq_length)
-        toks = np.zeros((1, Tb), np.int32)
-        toks[0, :chunk] = seq.tokens[seq.fed : seq.fed + chunk]
+        # block coverage for every entry's writes; growth may preempt —
+        # _live_reserved keeps only entries whose sequence still owns its
+        # slot afterwards (a victim resumes from the queue, fed intact)
+        need = {id(s): n for s, n in entries}
+        live = [
+            (s, need[id(s)])
+            for s in self._live_reserved(
+                [s for s, _ in entries], lambda s: need[id(s)]
+            )
+        ]
+        if not live:
+            return
+        B = self.scheduler.max_batch
+        T = self.token_budget
+        trash_pos = self.max_blocks_per_seq * self.pool.block_size
+        tokens = np.zeros((1, T), np.int32)
+        # padding positions sit past every table's coverage, so their K/V
+        # writes land in the reserved trash block whatever slot id they
+        # carry (ops/paged_attention.paged_update's overflow redirect)
+        pos = np.full((1, T), trash_pos, np.int32)
+        q_slot = np.zeros((T,), np.int32)
+        q_start = np.zeros((B,), np.int32)
+        q_len = np.zeros((B,), np.int32)
+        last_idx = np.zeros((B,), np.int32)
+        off = 0
+        for seq, n in live:
+            feed = (seq.tokens[seq.fed : seq.fed + n]
+                    if seq.needs_prefill else [seq.next_tok])
+            tokens[0, off : off + n] = feed
+            pos[0, off : off + n] = np.arange(seq.fed, seq.fed + n)
+            q_slot[off : off + n] = seq.slot
+            q_start[seq.slot] = off
+            q_len[seq.slot] = n
+            last_idx[seq.slot] = off + n - 1
+            off += n
+        tables = self._sync_tables([s for s, _ in live])
         kv = self._kv
         self._kv = None  # donated
         try:
-            last, self._kv = self._prefill_fn(Tb)(
-                self.gen.params, jnp.asarray(toks), kv,
-                jnp.asarray(self._table_row(seq)[None, :]),
-                jnp.asarray([seq.fed], jnp.int32),
-                jnp.asarray([chunk], jnp.int32),
+            nxt, self._kv, self.gen.key = self._mixed_fn(B, T)(
+                self.gen.params, jnp.asarray(tokens), kv,
+                jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(q_slot),
+                jnp.asarray(q_start), jnp.asarray(q_len),
+                jnp.asarray(last_idx), self.gen.key, self._t_op, self._p_op,
+                mode=self._sample_mode, top_k=self.cfg.top_k,
             )
         except Exception:
             # keep the engine debuggable after a failed dispatch: restore
@@ -421,29 +526,41 @@ class ServingEngine:
             # with jax's clear deleted-buffer error, not a paged-cache one)
             self._kv = kv
             raise
-        seq.fed += chunk
-        self.stats.prefill_tokens += chunk
-        self.stats.prefill_chunks += 1
-        if seq.fed >= seq.prefill_target:
-            # prompt (as far as it was actually FED) is in the pool: publish
-            # its full blocks for prefix reuse.  Only now — registering
-            # before the KV is written would let a concurrent request attend
-            # garbage — and only up to `fed`: a resumed sequence's prefill
-            # stops one token short (the pending token decodes later), so a
-            # block-aligned prompt would otherwise register a block whose
-            # last slot is still unwritten.
-            self.pool.register_prefix(
-                seq.blocks, seq.req.prompt[: seq.fed]
-            )
-            if seq.resume_tok is not None:
-                seq.next_tok = seq.resume_tok  # preserved across preemption
+        nxt = np.asarray(nxt)  # mdi-lint: disable=host-sync -- THE unified step's one boundary read: a single sync serves every decode lane and prefill chunk in the batch
+        self.stats.mixed_steps += 1
+        self.stats.host_syncs += 1
+        self.stats.observe_dispatch(T, off)
+        self.stats.observe_mixed_occupancy(len(live), B)
+        self.stats.observe_kv_utilization(self.pool.utilization)
+        any_decode = False
+        for seq, n in live:
+            if seq.needs_prefill:
+                seq.fed += n
+                self.stats.prefill_tokens += n
+                self.stats.prefill_chunks += 1
+                if seq.fed >= seq.prefill_target:
+                    # prompt (as far as it was actually FED) is in the pool:
+                    # publish its full blocks for prefix reuse.  Only now —
+                    # registering before the KV is written would let a
+                    # concurrent request attend garbage — and only up to
+                    # `fed`: a resumed sequence's prefill stops one token
+                    # short (the pending token decodes later), so a
+                    # block-aligned prompt would otherwise register a block
+                    # whose last slot is still unwritten.
+                    self.pool.register_prefix(
+                        seq.blocks, seq.req.prompt[: seq.fed]
+                    )
+                    if seq.resume_tok is not None:
+                        # preserved across preemption
+                        seq.next_tok = seq.resume_tok
+                    else:
+                        self._emit(seq, int(nxt[seq.slot]))
             else:
-                self.gen.key, sub = jax.random.split(self.gen.key)
-                tok = sample(
-                    last, sub, temperature=self.cfg.temperature,
-                    top_k=self.cfg.top_k, top_p=self.cfg.top_p,
-                )
-                self._emit(seq, int(np.asarray(tok)[0]))
+                any_decode = True
+                seq.fed += 1
+                self._emit(seq, int(nxt[seq.slot]))
+        if any_decode:
+            self.stats.decode_steps += 1
         self.stats.prefill_s += time.perf_counter() - t0
 
     def _emit(self, seq: SequenceState, tok: int) -> None:
@@ -502,11 +619,12 @@ class ServingEngine:
                 mode=self._sample_mode, top_k=self.cfg.top_k,
             )
         except Exception:
-            self._kv = kv  # see _run_prefill: keep failures diagnosable
+            self._kv = kv  # see _run_mixed: keep failures diagnosable
             raise
         nxt = np.asarray(nxt)
         self.stats.decode_steps += 1
         self.stats.host_syncs += 1
+        self.stats.observe_dispatch(B, len(live))
         self.stats.observe_kv_utilization(self.pool.utilization)
         for seq in live:
             seq.fed += 1
@@ -557,6 +675,7 @@ class ServingEngine:
                 self._emit(seq, int(toks[s, seq.slot]))
                 if seq.done:
                     break
+            self.stats.tokens_useful += emitted  # drain-time useful credit
             if seq.done or emitted < lim:
                 clean = False
         return clean
@@ -616,9 +735,13 @@ class ServingEngine:
                     mode=self._sample_mode, top_k=self.cfg.top_k,
                 )
             except Exception:
-                self._kv = kv  # see _run_prefill: keep failures diagnosable
+                self._kv = kv  # see _run_mixed: keep failures diagnosable
                 raise
             self.stats.decode_steps += K
+            # useful side credited at drain time: only tokens actually
+            # retained count (a lane stop-frozen mid-chunk reports its
+            # remaining steps as padding, per the padded_token_frac contract)
+            self.stats.observe_dispatch(B * K, 0)
             clean = True
             if pending is not None:
                 prev_limits, prev_toks = pending
@@ -705,11 +828,15 @@ class ServingEngine:
                 jnp.asarray(tables), jnp.asarray(pos),
             )
         except Exception:
-            self._kv = kv  # see _run_prefill: keep failures diagnosable
+            self._kv = kv  # see _run_mixed: keep failures diagnosable
             raise
         g = np.asarray(g)
         self.stats.decode_steps += 1
         self.stats.host_syncs += 1
+        # useful side credited below per slot as len(burst) — the pending
+        # row plus ACCEPTED draft rows; rejected draft rows are padding
+        # (the padded_token_frac contract)
+        self.stats.observe_dispatch(B * (K + 1), 0)
         self.stats.observe_kv_utilization(self.pool.utilization)
         for seq in live:
             d = drafts.get(seq.slot, [])
@@ -718,6 +845,7 @@ class ServingEngine:
             burst = accept_draft(pad_draft(d, K), g[seq.slot], len(d))
             self.stats.spec_drafted += len(d)
             self.stats.spec_accepted += len(burst) - 1
+            self.stats.tokens_useful += len(burst)
             for t in burst:
                 seq.fed += 1
                 self._emit(seq, int(t))
@@ -727,13 +855,16 @@ class ServingEngine:
         return True
 
     def step(self) -> bool:
-        """Run one scheduler action; False when nothing was runnable."""
-        action = self.scheduler.next_action()
+        """Run one scheduler action; False when nothing was runnable.
+
+        Any pending prefill work rides the unified mixed step together
+        with every decode lane; pure-decode turns run the multi-token
+        machinery (chunked scan / speculative verify) unchanged."""
+        action = self.scheduler.next_batch(self.token_budget)
         if action is None:
             return False
-        if action[0] == "prefill":
-            _, seq, chunk = action
-            self._run_prefill(seq, chunk)
+        if action[0] == "mixed":
+            self._run_mixed(action[1])
         elif self.cfg.spec_k and self._run_spec_decode(action[1]):
             pass  # speculative verify served this decode turn
         elif self.cfg.decode_chunk > 1:

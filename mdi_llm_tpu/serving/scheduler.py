@@ -2,15 +2,19 @@
 
 Policy layer between the request queue and the device loop
 (`serving.engine.ServingEngine`): FCFS admission into a fixed set of decode
-slots, chunked prefill interleaved with batched decode, mid-batch
-retirement, and recompute-style preemption when the block pool runs dry.
+slots, token-budget mixed-batch composition (Sarathi-style: decode lanes
+first, then prefill chunks split to fit), mid-batch retirement, and
+recompute-style preemption when the block pool runs dry.
 
 The scheduler never touches device arrays — it owns `SequenceState`
 bookkeeping (token lists, block tables, feed positions) and the `KVPool`
-accounting, and hands the engine one action at a time:
+accounting, and hands the engine one action at a time (`next_batch`):
 
-    ("prefill", seq, chunk_len)   feed the next `chunk_len` tokens of `seq`
-    ("decode", [seqs])            one batched decode step over the live slots
+    ("mixed", [(seq, n_tokens), ...])  ONE unified ragged forward: every
+                                  decode-ready lane's pending token plus
+                                  prefill chunks filling the token budget
+    ("decode", [seqs])            no prefill work pending — the engine's
+                                  chunked/speculative decode paths take over
     None                          nothing runnable (queue empty or blocked)
 
 Feed-position invariants (`SequenceState`):
@@ -64,7 +68,7 @@ class SequenceState:
         )
         self.next_tok: Optional[int] = None  # sampled, not yet fed
         # a fully-prefix-cached resume needs no prefill at all: the pending
-        # token is restored immediately so next_action sees it decode-ready
+        # token is restored immediately so next_batch sees it decode-ready
         if resume_tokens and self.fed >= self.prefill_target:
             self.next_tok = self.resume_tok
         self.done = False
@@ -101,7 +105,6 @@ class Scheduler:
         self.preempted: Deque[Tuple[Request, List[int]]] = deque()
         self.slots: List[Optional[SequenceState]] = [None] * max_batch
         self.finished: List[SequenceState] = []
-        self._decode_turn = False  # prefill/decode interleave flip-flop
         self._admit_counter = 0  # admission recency for preemption order
         self.preemptions = 0
 
@@ -269,22 +272,41 @@ class Scheduler:
 
     # -- action selection ----------------------------------------------------
 
-    def next_action(self):
+    def next_batch(self, token_budget: int):
         """One step of the continuous-batching policy: admit whatever fits,
-        then alternate prefill chunks with decode steps while both kinds of
-        work exist (so a long prompt cannot stall live decodes)."""
+        then compose the step's token batch under `token_budget` — decode
+        lanes FIRST (one pending token each, so a long prompt can never
+        starve a live decode), then prefill chunks packed into the
+        remaining budget in admission order, each capped at
+        `prefill_chunk` and split across steps when the remainder is
+        smaller than the prompt's tail.
+
+        Returns ``("mixed", [(seq, n_tokens), ...])`` whenever any prefill
+        work rides along (the engine runs ONE unified ragged forward),
+        ``("decode", [seqs])`` when only decode lanes are live (the
+        engine's chunked/speculative multi-token paths take over), or
+        ``None`` when nothing is runnable.  With ``token_budget >
+        max_batch`` (enforced by the engine and mdi-audit) at least one
+        prefill token fits every mixed step, so prefill always makes
+        progress."""
         self.admit()
-        prefilling = [s for s in self.running() if s.needs_prefill]
+        prefilling = sorted(
+            (s for s in self.running() if s.needs_prefill),
+            key=lambda s: s.admit_order,
+        )
         decoding = [
             s for s in self.running()
             if not s.needs_prefill and s.next_tok is not None
         ]
-        if prefilling and (not decoding or not self._decode_turn):
-            self._decode_turn = True
-            seq = prefilling[0]
-            chunk = min(self.prefill_chunk, seq.prefill_target - seq.fed)
-            return ("prefill", seq, chunk)
-        if decoding:
-            self._decode_turn = False
-            return ("decode", decoding)
-        return None
+        if not prefilling:
+            return ("decode", decoding) if decoding else None
+        entries: List[Tuple[SequenceState, int]] = [(s, 1) for s in decoding]
+        budget = token_budget - len(entries)
+        for seq in prefilling:
+            if budget <= 0:
+                break
+            chunk = min(self.prefill_chunk, seq.prefill_target - seq.fed,
+                        budget)
+            entries.append((seq, chunk))
+            budget -= chunk
+        return ("mixed", entries)

@@ -240,6 +240,33 @@ def test_serving_chunk_headroom_budgeted():
     assert any("greedy" in f.message for f in r.findings)
 
 
+def test_bad_token_budget_rejected():
+    """The unified-step token budget must exceed max_batch (decode lanes
+    pack first; anything at or below starves prefill forever): exactly one
+    finding with a suggested value, both for budget == max_batch and
+    budget < max_batch."""
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), serving=ServingConfig(max_batch=8, token_budget=8),
+    ))
+    assert codes(r) == ["bad-token-budget"]
+    assert "token_budget >= 136" in r.findings[0].message  # 8 + 128
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), serving=ServingConfig(max_batch=8, token_budget=3),
+    ))
+    assert codes(r) == ["bad-token-budget"]
+    # the default (None -> max_batch + prefill_chunk) is always clean, and
+    # the kv_pool breakdown reports the resolved budget
+    r = audit_plan(PlanSpec(cfg=tiny(), serving=ServingConfig()))
+    assert "bad-token-budget" not in codes(r)
+    assert r.breakdown["kv_pool"]["token_budget"] == 8 + 128
+    # an explicit healthy budget passes and is reported as-is
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), serving=ServingConfig(max_batch=4, token_budget=64),
+    ))
+    assert "bad-token-budget" not in codes(r)
+    assert r.breakdown["kv_pool"]["token_budget"] == 64
+
+
 def test_pool_estimate_byte_exact_vs_live_engine_with_chunk_reservations():
     """The audited kv_pool bytes must equal the live engine's allocated
     pool byte-for-byte when chunked decode / speculative verify are on —

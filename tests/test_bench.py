@@ -218,6 +218,52 @@ def test_probe_failures_respect_retry_budget(monkeypatch):
     assert len(probes) == 5
 
 
+def test_probe_budget_is_a_hard_total_cap(monkeypatch):
+    """BENCH_r05 burned 900 s because each probe attempt got the full
+    budget again (events showed attempts still starting at t=420 s and
+    t=900 s despite the 180 s default).  The budget is TOTAL: an attempt
+    runs against the remaining window, retry sleeps draw from the same
+    budget, and the CPU fallback starts the moment it expires."""
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: clock[0])
+    monkeypatch.setattr(
+        bench.time, "sleep", lambda s: clock.__setitem__(0, clock[0] + s)
+    )
+    probes = []
+
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            probes.append(timeout)
+            clock[0] += timeout  # the probe hangs for its whole window
+            return None, "timeout"
+        return _row(0.7), None
+
+    monkeypatch.setattr(bench, "_child", child)
+    out = bench.run_suite(_args(probe_timeout=180.0, probe_retries=5))
+    # one attempt consumed the entire budget: no retry may start after it
+    assert len(probes) == 1 and probes[0] <= 180.0
+    assert sum(probes) <= 180.0
+    assert "tinyllama-bf16-cpu-fallback" in out["detail"]["rows"]
+
+    # a half-budget hang leaves room for exactly one shorter retry (minus
+    # the 60 s sleep), never a fresh full-length attempt
+    probes.clear()
+    clock[0] = 0.0
+
+    def child_half(argv, timeout, env=None):
+        if "--probe" in argv:
+            probes.append(timeout)
+            clock[0] += min(timeout, 90.0)
+            return None, "error: no backend"
+        return _row(0.7), None
+
+    monkeypatch.setattr(bench, "_child", child_half)
+    bench.run_suite(_args(probe_timeout=180.0, probe_retries=5))
+    assert len(probes) == 2
+    assert probes[0] <= 180.0 and probes[1] <= 180.0 - 90.0
+    assert sum(probes) <= 180.0 + 60.0  # sleeps bounded by the budget too
+
+
 def test_costly_compiles_run_after_every_decode_row():
     # the ring row has the costliest compile in the suite (its r5 cold
     # compile blew a 900 s timeout and wedged the tunnel); it and the

@@ -13,6 +13,7 @@ from mdi_llm_tpu.ops.attention import multihead_attention
 from mdi_llm_tpu.ops.paged_attention import (
     gather_paged_kv,
     paged_attention,
+    paged_prefill,
     paged_update,
 )
 
@@ -158,6 +159,136 @@ def test_wide_tq_stays_on_fallback():
     )
     # identical (not just close): both routes are the same lax fallback
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def _pack_mixed(slots_spec, H, hs, T, seed=0):
+    """Build a packed ragged mixed batch: slots_spec is [(slot, start_pos,
+    n_tokens), ...] laid out slot-major; the tail up to T pads with slot 0
+    at an arbitrary in-window position (the op's contract: padding rows are
+    garbage, the caller discards them)."""
+    rng = np.random.default_rng(seed)
+    n_slots = max(s for s, _, _ in slots_spec) + 1
+    q = jnp.asarray(rng.standard_normal((1, H, T, hs)), jnp.float32)
+    q_slot = np.zeros((T,), np.int32)
+    q_pos = np.zeros((T,), np.int32)
+    q_start = np.zeros((n_slots,), np.int32)
+    q_len = np.zeros((n_slots,), np.int32)
+    off = 0
+    for slot, p0, n in slots_spec:
+        q_slot[off : off + n] = slot
+        q_pos[off : off + n] = np.arange(p0, p0 + n)
+        q_start[slot] = off
+        q_len[slot] = n
+        off += n
+    return (q, jnp.asarray(q_slot), jnp.asarray(q_start),
+            jnp.asarray(q_len), jnp.asarray(q_pos), off)
+
+
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1)],
+                         ids=["mha", "gqa", "mqa"])
+def test_paged_prefill_fallback_matches_dense(heads):
+    """The unified mixed step's ragged op: a decode lane (1 token), a
+    prefill chunk (5 tokens crossing a block boundary), an absent slot,
+    and batch-tail padding, all packed into ONE query axis — every real
+    row must equal the dense op on that slot's contiguous KV bit-for-bit
+    (the greedy parity contract of the serving engine)."""
+    H, G = heads
+    B, hs, S, BS, T = 3, 16, 32, 8, 9
+    q, k, v = rand_qkv(B, H, G, S, hs, Tq=1, seed=5)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    # slot 0: decode at pos 13; slot 1: chunk at 6..10 (crosses block 1);
+    # slot 2: absent (q_len 0); 3 padding rows ride the tail
+    qp, q_slot, q_start, q_len, q_pos, off = _pack_mixed(
+        [(0, 13, 1), (1, 6, 5)], H, hs, T, seed=9
+    )
+    got = paged_prefill(qp, pool_k, pool_v, tables, q_slot, q_start, q_len,
+                        q_pos, use_kernel=False)
+    # dense reference on the SAME per-token lane layout, but with the
+    # CONTIGUOUS (unpaged) KV: shuffled block placement must be invisible
+    # bit-for-bit (reduction order across different lane layouts is XLA's
+    # to choose, so cross-shape comparisons are only token-level — pinned
+    # end-to-end by tests/test_serving.py)
+    qt = qp[0].transpose(1, 0, 2)[:, :, None, :]  # (T, H, 1, hs)
+    ref = multihead_attention(qt, k[q_slot], v[q_slot], q_pos[:, None])
+    np.testing.assert_array_equal(
+        np.asarray(got)[0, :, :off],
+        np.asarray(ref)[:off, :, 0, :].transpose(1, 0, 2),
+    )
+
+
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1)],
+                         ids=["mha", "gqa", "mqa"])
+def test_paged_prefill_kernel_matches_fallback(heads):
+    """The ragged prefill Pallas kernel (interpreter mode on CPU) must
+    agree with the exact per-token gather fallback on every REAL packed
+    row — per-slot scalar-prefetched spans, online softmax per
+    (head, packed token), masked scratch updates across slots."""
+    H, G = heads
+    B, hs, S, BS, T = 3, 16, 32, 8, 12
+    q, k, v = rand_qkv(B, H, G, S, hs, Tq=1, seed=7)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    # three live slots at very different depths + 2 padding rows
+    qp, q_slot, q_start, q_len, q_pos, off = _pack_mixed(
+        [(0, 30, 1), (1, 0, 6), (2, 17, 3)], H, hs, T, seed=11
+    )
+    ref = paged_prefill(qp, pool_k, pool_v, tables, q_slot, q_start, q_len,
+                        q_pos, use_kernel=False)
+    got = paged_prefill(qp, pool_k, pool_v, tables, q_slot, q_start, q_len,
+                        q_pos, use_kernel=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref)[0, :, :off], np.asarray(got)[0, :, :off],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_paged_prefill_wide_batch_chunked_fallback():
+    """A packed batch wider than the fallback's gather chunk (the real
+    serving shape: token_budget ~ 68-136) runs the chunked lax.map path —
+    the gathered-KV transient stays ∝ chunk, the math per row is unchanged
+    (kernel agreement on every real row)."""
+    from mdi_llm_tpu.ops.paged_attention import _LAX_FALLBACK_CHUNK
+
+    H, G, hs, S, BS = 4, 2, 8, 64, 8
+    T = 2 * _LAX_FALLBACK_CHUNK + 8  # crosses two chunk boundaries
+    q, k, v = rand_qkv(3, H, G, S, hs, Tq=1, seed=19)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    # slot 1 carries a long prefill chunk; slots 0/2 are decode lanes
+    qp, q_slot, q_start, q_len, q_pos, off = _pack_mixed(
+        [(0, 50, 1), (1, 0, 34), (2, 21, 1)], H, hs, T, seed=23
+    )
+    assert off > _LAX_FALLBACK_CHUNK
+    ref = paged_prefill(qp, pool_k, pool_v, tables, q_slot, q_start, q_len,
+                        q_pos, use_kernel=False)
+    got = paged_prefill(qp, pool_k, pool_v, tables, q_slot, q_start, q_len,
+                        q_pos, use_kernel=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref)[0, :, :off], np.asarray(got)[0, :, :off],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_paged_prefill_kernel_isolates_slots():
+    """A slot's rows must be untouched by OTHER slots' grid steps: the
+    masked-row scratch update is load-bearing (NEG_INF is finite, so an
+    unmasked update would add exp(0)=1-weighted V garbage to every other
+    slot's accumulator on each visited block).  A one-slot packing and the
+    same slot inside a multi-slot packing must agree exactly."""
+    H, G, hs, S, BS, T = 4, 2, 8, 24, 4, 8
+    q, k, v = rand_qkv(3, H, G, S, hs, Tq=1, seed=3)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    qp, q_slot, q_start, q_len, q_pos, _ = _pack_mixed(
+        [(0, 9, 2), (1, 20, 3), (2, 2, 1)], H, hs, T, seed=13
+    )
+    multi = paged_prefill(qp, pool_k, pool_v, tables, q_slot, q_start,
+                          q_len, q_pos, use_kernel=True, interpret=True)
+    # re-run with ONLY slot 1 live (same packed offsets, others absent)
+    solo_len = jnp.asarray(np.array([0, 3, 0], np.int32))
+    solo = paged_prefill(qp, pool_k, pool_v, tables, q_slot, q_start,
+                         solo_len, q_pos, use_kernel=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(multi)[0, :, 2:5], np.asarray(solo)[0, :, 2:5],
+        rtol=1e-6, atol=1e-6,
+    )
 
 
 def test_paged_update_slots_and_trash():

@@ -107,14 +107,16 @@ def test_scheduler_admission_and_slots():
     sched, pool = _sched()
     for i in range(3):
         sched.add(Request(f"r{i}", [1, 2, 3, 4, 5], 4))
-    kind, seq, chunk = sched.next_action()
-    assert kind == "prefill" and seq.req.rid == "r0" and chunk == 5
+    kind, entries = sched.next_batch(token_budget=32)
+    # both prompts fit the budget in ONE mixed batch, FCFS order
+    assert kind == "mixed"
+    assert [(s.req.rid, n) for s, n in entries] == [("r0", 5), ("r1", 5)]
     # both slots filled FCFS; third request waits
     rids = {s.req.rid for s in sched.running()}
     assert rids == {"r0", "r1"} and len(sched.waiting) == 1
-    # retiring r0 frees the slot; r2 admits on the next action
+    # retiring r0 frees the slot; r2 admits on the next batch
     sched.retire(sched.running()[0])
-    sched.next_action()
+    sched.next_batch(token_budget=32)
     assert {s.req.rid for s in sched.running()} == {"r1", "r2"}
 
 
@@ -131,21 +133,58 @@ def test_scheduler_rejects_impossible_requests():
         sched2.add(Request("huge", [1] * 20, 10))
 
 
-def test_scheduler_interleaves_prefill_and_decode():
-    """With one sequence decoding and another prefilling, actions must
-    alternate so a long prompt cannot stall live decodes."""
-    sched, _ = _sched(prefill_chunk=4, max_seq_length=64)
+def _complete_prefill(entries):
+    """Simulate the engine crediting a mixed batch's prefill feeds."""
+    for seq, n in entries:
+        if seq.needs_prefill:
+            seq.fed += n
+            if seq.fed >= seq.prefill_target and seq.next_tok is None:
+                seq.next_tok = 7
+                seq.tokens.append(7)
+
+
+def test_scheduler_token_budget_composition():
+    """The mixed batch packs decode lanes FIRST, then prefill chunks split
+    to fit the remaining token budget — a prompt longer than the leftover
+    feeds across several steps, and a decode lane rides EVERY one of those
+    steps (no starvation behind a long prefill)."""
+    sched, _ = _sched(prefill_chunk=32, max_seq_length=64)
     sched.add(Request("a", [1, 2, 3], 8))
-    kind, seq_a, chunk = sched.next_action()
-    assert kind == "prefill"
-    seq_a.fed = seq_a.prefill_target  # simulate engine completing prefill
-    seq_a.next_tok = 7
-    seq_a.tokens.append(7)
+    kind, entries = sched.next_batch(token_budget=10)
+    assert kind == "mixed" and [n for _, n in entries] == [3]
+    _complete_prefill(entries)  # "a" is now decode-ready
     sched.add(Request("b", [1] * 20, 4))
-    kinds = [sched.next_action()[0] for _ in range(4)]
-    # strict alternation (starting phase depends on flip-flop history)
-    assert sorted(kinds) == ["decode", "decode", "prefill", "prefill"]
-    assert kinds[0] != kinds[1] and kinds[2] != kinds[3]
+    steps = []
+    while True:
+        action = sched.next_batch(token_budget=10)
+        if action[0] != "mixed":
+            break
+        kind, entries = action
+        steps.append([(s.req.rid, n, s.needs_prefill) for s, n in entries])
+        _complete_prefill(entries)
+    # budget 10 - 1 decode lane = 9 prefill tokens/step: 20-token prompt
+    # splits 9 + 9 + 2, and "a"'s decode token leads every mixed batch
+    assert steps == [
+        [("a", 1, False), ("b", 9, True)],
+        [("a", 1, False), ("b", 9, True)],
+        [("a", 1, False), ("b", 2, True)],
+    ]
+    # with no prefill work left the engine's decode paths take over
+    kind, seqs = sched.next_batch(token_budget=10)
+    assert kind == "decode" and {s.req.rid for s in seqs} == {"a", "b"}
+
+
+def test_scheduler_budget_packs_multiple_prefills():
+    """Several prefilling prompts share one mixed batch in admission order,
+    each capped at prefill_chunk, until the budget runs out."""
+    sched, _ = _sched(max_batch=3, prefill_chunk=4, max_seq_length=64)
+    for i, plen in enumerate((6, 3, 9)):
+        sched.add(Request(f"r{i}", [1] * plen, 4))
+    kind, entries = sched.next_batch(token_budget=8)
+    assert kind == "mixed"
+    # chunk cap 4 for r0, then 3 for r1, then the 1 leftover for r2
+    assert [(s.req.rid, n) for s, n in entries] == \
+        [("r0", 4), ("r1", 3), ("r2", 1)]
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +255,98 @@ def test_engine_stop_sequences_match_generate(served_model):
     results, _ = engine.run()
     assert results["stopped"] == want[0]
     assert results["free"] == want[1]
+
+
+def test_engine_long_prompt_splits_across_budget_steps(served_model):
+    """A prompt longer than the unified step's token budget must feed
+    across several mixed steps with outputs still token-identical — and
+    the whole run stays inside the static (1, token_budget) dispatch
+    (padded_token_frac strictly below 1, occupancy sane)."""
+    cfg, params = served_model
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+               for n in (41, 5)]
+    max_news = [6, 10]
+    want = _sequential_greedy(cfg, params, prompts, max_news)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, prefill_chunk=64, token_budget=12,
+    )
+    for i, (p, m) in enumerate(zip(prompts, max_news)):
+        engine.add_request(f"r{i}", p, m)
+    results, stats = engine.run()
+    for i in range(len(prompts)):
+        assert results[f"r{i}"] == want[i], f"r{i} diverged across splits"
+    # 41 prompt tokens through a <=12-token budget: several mixed steps
+    assert stats.mixed_steps >= 4
+    assert stats.prefill_chunks >= 4
+    assert stats.tokens_useful > 0
+    assert 0.0 <= stats.padded_token_frac < 1.0
+    assert 0.0 < stats.mixed_batch_occupancy <= 1.0
+
+
+def test_engine_decode_lanes_not_starved_by_long_prefill(served_model):
+    """While a long prompt is still prefilling, every unified step must
+    also advance the live decode lanes: a short request that goes
+    decode-ready before a long prompt arrives finishes BEFORE that
+    prompt's prefill completes."""
+    cfg, params = served_model
+    rng = np.random.default_rng(17)
+    short = rng.integers(1, cfg.vocab_size, 4).tolist()
+    long = rng.integers(1, cfg.vocab_size, 60).tolist()
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, prefill_chunk=64, token_budget=8,
+    )
+    engine.add_request("short", short, 3)
+    assert engine.step()  # short's prompt fits one step: now decode-ready
+    engine.add_request("long", long, 4)
+    # 60 prompt tokens at <=7/step (1 budget slot goes to short's decode
+    # lane) need >= 9 mixed steps; short needs only 2 more tokens
+    for _ in range(4):
+        assert engine.step()
+    long_seq = [s for s in engine.scheduler.running()
+                if s.req.rid == "long"][0]
+    assert long_seq.needs_prefill, "budget sized so long is still prefilling"
+    assert "short" in engine._results, \
+        "decode lanes starved behind the long prefill"
+    results, stats = engine.run()
+    want = _sequential_greedy(cfg, params, [short, long], [3, 4])
+    assert results["short"] == want[0] and results["long"] == want[1]
+
+
+def test_engine_rejects_token_budget_at_or_below_max_batch(served_model):
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="token_budget"):
+        gen.serve(max_batch=4, token_budget=4)
+    with pytest.raises(ValueError, match="token_budget"):
+        gen.serve(max_batch=4, token_budget=2)
+
+
+def test_preemption_mid_prefill_resumes_with_correct_fed(served_model):
+    """A sequence preempted while still PREFILLING (the older lane's decode
+    growth drains the pool mid-way through the newer prompt's budget-split
+    feed) must resume from the queue and re-feed to the exact `fed`
+    contract — outputs token-identical, blocks fully rolled back."""
+    cfg, params = served_model
+    rng = np.random.default_rng(21)
+    short = rng.integers(1, cfg.vocab_size, 4).tolist()
+    long = rng.integers(1, cfg.vocab_size, 36).tolist()
+    # both admit (2 + 10 of 12 usable blocks), but short's decode growth
+    # past 8 tokens needs a 3rd block with 0 free — the newer, still-
+    # prefilling long prompt is the preemption victim (5 tokens/step over
+    # a 6-token budget means its 36-token feed is mid-flight at that point)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, max_blocks=1 + 12, prefix_caching=False,
+        token_budget=6, decode_chunk=1,
+    )
+    engine.add_request("short", short, 28)
+    engine.add_request("long", long, 4)
+    results, stats = engine.run()
+    assert stats.preemptions >= 1, "pool was sized to force preemption"
+    want = _sequential_greedy(cfg, params, [short, long], [28, 4])
+    assert results["short"] == want[0], "short diverged"
+    assert results["long"] == want[1], "long diverged across its preemption"
+    assert engine.pool.used == 0
 
 
 def test_engine_prefix_cache_reuses_blocks(served_model):
