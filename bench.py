@@ -164,6 +164,12 @@ def build_parser():
     ap.add_argument("--spec-k", type=int, default=0,
                     help="serve mode: n-gram speculative draft length "
                     "(greedy only; 0 disables)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="serve mode: tensor-parallel devices — the model "
+                    "shards under the Megatron rules and the paged KV "
+                    "pool splits its head dimension over a tp mesh "
+                    "(make_mesh); the row reports tokens/s/chip and "
+                    "records devices/tp in detail")
     ap.add_argument("--no-double-buffer", action="store_true",
                     help="serve mode: disable overlapping chunk N's host "
                     "read with chunk N+1's compute")
@@ -232,6 +238,7 @@ def run_preflight(args, cfg, mode):
         cfg,
         n_stages=args.pipeline or 1,
         pipeline=bool(args.pipeline) if mode == "decode" else False,
+        tp=getattr(args, "tp", 1) if mode == "serve" else 1,
         samples_per_slot=args.samples_per_slot,
         n_samples=args.batch,
         batch=args.batch,
@@ -496,7 +503,7 @@ def run_serve(args):
     kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
     cfg = Config.from_name(args.model)
     if args.pipeline:
-        raise SystemExit("--mode serve runs the single-chip engine; drop --pipeline")
+        raise SystemExit("--mode serve runs the tp-mesh engine; drop --pipeline")
     audit = run_preflight(args, cfg, "serve")
     if args.quantize != "none":
         from mdi_llm_tpu.ops.quant import FLAG_TO_MODE, init_quantized_params
@@ -506,9 +513,14 @@ def run_serve(args):
         ))
     else:
         params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    mesh = None
+    if args.tp > 1:
+        from mdi_llm_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"tp": args.tp})
     gen = Generator(
         cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
-        scan_unroll=args.scan_unroll,
+        mesh=mesh, scan_unroll=args.scan_unroll,
     )
     n_requests = args.serve_requests or 4 * args.batch
 
@@ -549,17 +561,23 @@ def run_serve(args):
         results, stats = engine.run()
         wall = time.perf_counter() - t0
 
-    value = stats.tokens_generated / wall if wall else 0.0
+    n_chips = max(1, args.tp)
+    total = stats.tokens_generated / wall if wall else 0.0
+    value = total / n_chips  # tokens/s/CHIP: the cross-topology comparable
     base = baseline_for(args.model)
+    tp_tag = f", tp={args.tp}" if args.tp > 1 else ""
     return {
         "metric": f"serving tokens/sec/chip ({args.model}, cb, "
-                  f"slots={args.batch}, reqs={n_requests})",
+                  f"slots={args.batch}, reqs={n_requests}{tp_tag})",
         "value": round(value, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(value / base, 2),
         "detail": {
             "tokens_generated": stats.tokens_generated,
             "requests": stats.requests_finished,
+            "tokens_per_s_total": round(total, 2),
+            "devices": n_chips,
+            "tp": args.tp,
             "wall_s": round(wall, 2),
             "decode_steps": stats.decode_steps,
             "mixed_steps": stats.mixed_steps,
@@ -816,6 +834,19 @@ SUITE_ROWS = [
         "ladder": [["--serve-chunk", "1"],
                    ["--batch", "4", "--new-tokens", "64"]],
         "timeout": 900,
+    },
+    {  # the first MULTI-CHIP serving row: the same cb trace with the model
+        # Megatron-sharded and the paged pool's KV-group axis split over a
+        # tp mesh (unit stays tokens/s/chip; detail records devices/tp and
+        # the total).  tp=4 is TinyLlama's max shardable degree
+        # (n_query_groups=4); the ladder drops to tp=2, then the
+        # single-chip engine, so a collective/mesh failure still records a
+        # serving row
+        "name": "serving-cb-tp4",
+        "flags": ["--mode", "serve", "--tp", "4", "--batch", "8",
+                   "--seq-len", "512", "--new-tokens", "128"],
+        "ladder": [["--tp", "2"], ["--tp", "1"]],
+        "timeout": 1200,
     },
     {  # flash-VJP training on hardware: --train-flash on forces the Pallas
         # custom_vjp (fails loudly if it cannot engage, e.g. a backend whose

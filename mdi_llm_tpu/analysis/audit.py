@@ -102,6 +102,10 @@ AUDIT_RULES: Dict[str, Tuple[str, str]] = {
         ERROR, "the unified serving step's token budget cannot fit one "
         "decode token per max_batch slot plus any prefill chunk token "
         "(prefill could never progress)"),
+    "bad-serving-mesh": (
+        ERROR, "the serving plan's mesh cannot shard the paged-KV pool "
+        "(n_query_groups % tp != 0, or a dp/other >1 axis the engine "
+        "does not support)"),
 }
 
 GiB = float(1 << 30)
@@ -462,9 +466,13 @@ def _check_memory(
         )
         if plan.serving is not None:
             # an invalid pool geometry is already a bad-serving-config
-            # finding; budget it as zero instead of dividing by block_size
+            # finding; budget it as zero instead of dividing by block_size.
+            # Per DEVICE: the pool's KV-group axis shards over tp
+            # (paged_kv_spec), so each chip holds exactly 1/tp of the pool
             kv_dev = max(0, (
-                plan.serving.pool_bytes(cfg, plan.seq_len, plan.kv_dtype)
+                plan.serving.pool_bytes_per_device(
+                    cfg, _serving_tp(plan), plan.seq_len, plan.kv_dtype
+                )
                 if plan.serving.block_size >= 1 else 0
             ))
         else:
@@ -504,7 +512,11 @@ def _check_memory(
     avail = budget - params_dev - act_dev
     fits: Dict[str, Any] = {}
     if plan.serving is not None:
-        per_block = cfg.estimate_kv_bytes(1, plan.serving.block_size, plan.kv_dtype)
+        # per-device block cost under the tp-sharded pool layout: the HBM
+        # budget is per chip, so blocks-that-fit scales with the tp degree
+        per_block = cfg.estimate_kv_bytes(
+            1, plan.serving.block_size, plan.kv_dtype
+        ) // _serving_tp(plan)
         fits["max_pool_blocks"] = max(0, int(avail // per_block)) if per_block else 0
     else:
         if plan.is_pipeline:
@@ -643,10 +655,54 @@ def _check_stages(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
         breakdown["stage_layers"] = counts
 
 
+def _serving_tp(plan: PlanSpec) -> int:
+    """The tp degree a serving plan shards its pool over: the declared tp
+    axis when the KV-group axis divides (the `paged_kv_spec` layout),
+    else 1 — mirroring the runtime's drop-indivisible-sharding rule so the
+    byte estimates stay exact even on a plan the mesh checker flags."""
+    tp = plan.mesh.size(plan.tp_axis) if plan.tp_axis else 1
+    if tp > 1 and plan.cfg.n_query_groups % tp == 0:
+        return tp
+    return 1
+
+
+def _check_serving_mesh(plan: PlanSpec, findings: List[Finding]) -> None:
+    """The serving engine's mesh contract (`serving.engine.
+    validate_serving_mesh` + `paged_kv_spec`), checked statically: the only
+    axis that may exceed 1 is tp, and tp must divide n_query_groups (the
+    pool shards its KV-group axis — an indivisible G would silently
+    replicate the pool, tp-fold the HBM the budget promised)."""
+    sv = plan.serving
+    if sv is None:
+        return
+    tp = plan.mesh.size(plan.tp_axis) if plan.tp_axis else 1
+    if tp > 1 and plan.cfg.n_query_groups % tp:
+        findings.append(_finding(
+            plan, "bad-serving-mesh",
+            f"tp={tp} does not divide n_query_groups="
+            f"{plan.cfg.n_query_groups} of {plan.cfg.name}: the paged pool "
+            "shards its KV-group axis (paged_kv_spec), so serving would "
+            "silently replicate the whole pool on every chip",
+        ))
+    for name, size in plan.mesh.axes:
+        if name == plan.tp_axis or size <= 1:
+            continue
+        what = ("dp>1 serving is unsupported (requests are scheduler-"
+                "routed, not batch-split; run one engine per replica)"
+                if name == (plan.dp_axis or "dp")
+                else "only the tp axis shards the paged pool")
+        findings.append(_finding(
+            plan, "bad-serving-mesh",
+            f"serving mesh axis {name!r} (size {size}): {what} — "
+            "Generator.serve() refuses this mesh",
+        ))
+
+
 def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
     sv = plan.serving
     if sv is None:
         return
+    _check_serving_mesh(plan, findings)
     problems = []
     if sv.block_size < 1:
         problems.append(f"block_size={sv.block_size} must be positive")
@@ -704,10 +760,17 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
                 "prefill_chunk) or leave it None for that default",
             ))
     if sv.block_size >= 1:
+        tp = _serving_tp(plan)
         breakdown["kv_pool"] = {
             "num_blocks": n_blocks,
             "block_size": sv.block_size,
             "pool_bytes": sv.pool_bytes(plan.cfg, plan.seq_len, plan.kv_dtype),
+            # per-device slice of the tp-sharded pool (== pool_bytes / tp,
+            # exactly: the KV-group axis divides or bad-serving-mesh fires)
+            "pool_bytes_per_device": sv.pool_bytes_per_device(
+                plan.cfg, tp, plan.seq_len, plan.kv_dtype
+            ),
+            "tp": tp,
             "decode_chunk": sv.decode_chunk,
             "spec_k": sv.spec_k,
             "reserve_headroom_blocks": headroom,

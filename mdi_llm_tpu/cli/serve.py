@@ -84,6 +84,13 @@ def build_parser():
                     "steps (transformer.run_blocks(unroll=)): divides the "
                     "per-layer while-loop fixed cost that dominates small "
                     "models (docs/perf.md hypothesis 1)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel serving: shard the model "
+                    "(Megatron rules) and the paged KV pool's head "
+                    "dimension over a tp-axis mesh of this many devices "
+                    "(make_mesh); n_query_groups must divide by it — "
+                    "mdi-audit preflights the mesh (bad-serving-mesh). "
+                    "1 = single device")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable hash-based prefix block reuse")
     ap.add_argument("--temperature", type=float, default=0.0,
@@ -144,6 +151,7 @@ def main(argv=None):
     )
     report = preflight(
         resolve_config(args),
+        tp=args.tp,
         batch=args.max_batch,
         seq_len=args.sequence_length,
         dtype=args.dtype,
@@ -156,9 +164,14 @@ def main(argv=None):
     enforce_preflight(report, "mdi-serve", allow=args.no_preflight)
     pool = report.breakdown.get("kv_pool", {})
     if pool:
+        per_dev = (
+            f" ({pool['pool_bytes_per_device'] / 2**20:.1f} MiB/device over "
+            f"tp={pool['tp']})" if pool.get("tp", 1) > 1 else ""
+        )
         print(
             f"mdi-serve: KV pool {pool['num_blocks']} blocks x "
-            f"{pool['block_size']} tokens ~= {pool['pool_bytes'] / 2**20:.1f} MiB",
+            f"{pool['block_size']} tokens ~= {pool['pool_bytes'] / 2**20:.1f}"
+            f" MiB{per_dev}",
             file=sys.stderr,
         )
 
@@ -166,11 +179,17 @@ def main(argv=None):
         args, need_tokenizer=not args.synthetic
     )
     dtype = DTYPES[args.dtype]
+    mesh = None
+    if args.tp > 1:
+        from mdi_llm_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"tp": args.tp})
     gen = Generator(
         cfg, params,
         max_seq_length=args.sequence_length,
         cache_dtype=resolve_kv_dtype(args.kv_dtype) or dtype,
         quantize=args.quantize,
+        mesh=mesh,
         scan_unroll=args.scan_unroll,
     )
     # the audited config IS the engine config — no second hand-kept copy
@@ -214,6 +233,9 @@ def main(argv=None):
         "requests": stats.requests_finished,
         "tokens_generated": stats.tokens_generated,
         "tokens_per_s": round(stats.tokens_per_s, 2),
+        "tp": args.tp,
+        "devices": args.tp,
+        "tokens_per_s_per_chip": round(stats.tokens_per_s / max(1, args.tp), 2),
         "wall_s": round(stats.wall_s, 2),
         "decode_steps": stats.decode_steps,
         "mixed_steps": stats.mixed_steps,

@@ -521,6 +521,25 @@ class ServingConfig:
         n_blocks = self.num_pool_blocks(max_seq)
         return cfg.estimate_kv_bytes(1, n_blocks * self.block_size, dtype)
 
+    def pool_bytes_per_device(
+        self,
+        cfg: "Config",
+        tp: int = 1,
+        max_seq_length: Optional[int] = None,
+        dtype="bfloat16",
+    ) -> int:
+        """Per-device HBM bytes of the pool under a tp serving mesh: the
+        KV-group axis shards over tp (`parallel.sharding.paged_kv_spec`), so
+        each chip holds exactly 1/tp of every block's bytes.  Byte-exact
+        against the live sharded engine because G % tp == 0 is a serving
+        precondition (`validate_tp_divisibility`; mdi-audit errors with
+        `bad-serving-mesh` otherwise and this falls back to the whole pool,
+        mirroring the runtime's drop-indivisible-sharding rule)."""
+        total = self.pool_bytes(cfg, max_seq_length, dtype)
+        if tp > 1 and cfg.n_query_groups % tp == 0:
+            return total // int(tp)
+        return total
+
 
 def _yaml_scalar(v: Any) -> str:
     if v is None:

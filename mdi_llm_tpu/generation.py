@@ -300,6 +300,7 @@ class Generator:
         self.cfg = cfg
         self.mesh = mesh
         self._kv_sharding = None
+        self._paged_kv_sharding = None
         self._dp = 1
         self._moe_impl = None
         if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
@@ -373,6 +374,13 @@ class Generator:
                     "tp" if tp_n > 1 else None,
                 ),
             )
+            # serving engine's paged pool (L, NB, BS, G, hs): KV groups on
+            # tp, every block resident on every device's head-slice
+            from mdi_llm_tpu.parallel.sharding import paged_kv_spec
+
+            self._paged_kv_sharding = NamedSharding(
+                mesh, paged_kv_spec("tp" if tp_n > 1 else None)
+            )
         self.params = params
         if cache_dtype is None:
             cache_dtype = transformer.param_dtype(params)
@@ -402,6 +410,14 @@ class Generator:
         if self._kv_sharding is None:
             return kv
         return jax.device_put(kv, self._kv_sharding)
+
+    def _place_paged_kv(self, kv):
+        """Lay the serving engine's pooled block cache over the mesh: KV
+        groups sharded on tp (`parallel.sharding.paged_kv_spec`), block and
+        token axes resident everywhere.  No-op without a mesh."""
+        if self._paged_kv_sharding is None:
+            return kv
+        return jax.device_put(kv, self._paged_kv_sharding)
 
     # -- compiled phases -----------------------------------------------------
 
@@ -928,6 +944,13 @@ class Generator:
         (decode lanes + prefill chunks in ONE ragged forward per
         dispatch), mid-batch retirement, prefix-cached blocks.
 
+        Works on a single device or a tensor-parallel mesh: under
+        `mesh={"tp": N}` the paged pool shards its KV-group axis across
+        the chips (each holds its head-slice of every block) and every
+        serving dispatch runs the same per-shard math as the dense tp
+        forward — one all-reduce per layer.  Unsupported meshes (dp > 1,
+        ep/sp axes) are rejected HERE, before any pool is allocated.
+
         Pass a `ServingConfig`, or its fields as keywords::
 
             engine = gen.serve(block_size=16, max_batch=8)
@@ -935,8 +958,14 @@ class Generator:
             results, stats = engine.run()
         """
         from mdi_llm_tpu.config import ServingConfig
-        from mdi_llm_tpu.serving.engine import ServingEngine
+        from mdi_llm_tpu.serving.engine import (
+            ServingEngine,
+            validate_serving_mesh,
+        )
 
+        # fail at serve() time with the offending axis named — not deep
+        # inside engine init after the pool/scheduler are half-built
+        validate_serving_mesh(self.mesh)
         if serving is None:
             serving = ServingConfig(**knobs)
         elif knobs:

@@ -198,6 +198,10 @@ def attention_forward(
     # (T,), q_start (n_slots,), q_len (n_slots,)) — B == 1, tokens packed
     # slot-major, `paged_tables` is (n_slots, max_blocks) and every token
     # resolves reads/writes through its OWN slot's table row at `pos`
+    paged_shard: Optional[Tuple] = None,  # (Mesh, tp_axis) for the tensor-
+    # parallel serving engine: the Pallas kernel paths run per shard under
+    # jax.shard_map (heads/KV groups split); the lax fallback and the
+    # paged_update scatter are plain jnp and partition under GSPMD
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     B, T, D = x.shape
     qkv = linear(x, p["qkv"])
@@ -241,7 +245,7 @@ def attention_forward(
             )
             y = paged_prefill(
                 q, k_cache, v_cache, paged_tables, q_slot, q_start, q_len,
-                pos[0], use_kernel=paged_kernel,
+                pos[0], use_kernel=paged_kernel, shard_axes=paged_shard,
             )
         else:
             k_cache, v_cache = paged_update(
@@ -249,7 +253,8 @@ def attention_forward(
                 paged_tables, pos,
             )
             y = paged_attention(
-                q, k_cache, v_cache, paged_tables, pos, use_kernel=paged_kernel
+                q, k_cache, v_cache, paged_tables, pos,
+                use_kernel=paged_kernel, shard_axes=paged_shard,
             )
         y = y.swapaxes(1, 2).reshape(B, T, cfg.n_head * cfg.head_size)
         return linear(y.astype(x.dtype), p["proj"]), k_cache, v_cache
@@ -356,6 +361,7 @@ def block_forward(
     paged_tables: Optional[jnp.ndarray] = None,
     paged_kernel: Optional[bool] = None,
     paged_ragged: Optional[Tuple] = None,
+    paged_shard: Optional[Tuple] = None,
 ):
     """One transformer block (reference `Block`, model.py:576-629), both the
     parallel-residual (GPT-NeoX/Falcon/Phi) and sequential (Llama) forms.
@@ -367,7 +373,7 @@ def block_forward(
         cfg, p["attn"], n1, pos, cos, sin, k_cache, v_cache, input_pos, sp_axis,
         fresh_prefill, use_flash, sp_meta,
         paged_tables=paged_tables, paged_kernel=paged_kernel,
-        paged_ragged=paged_ragged,
+        paged_ragged=paged_ragged, paged_shard=paged_shard,
     )
     if cfg.parallel_residual:
         n2 = n1 if cfg.shared_attention_norm else _norm(cfg, x, p["norm_2"])
@@ -409,6 +415,7 @@ def run_blocks(
     paged_tables: Optional[jnp.ndarray] = None,
     paged_kernel: Optional[bool] = None,
     paged_ragged: Optional[Tuple] = None,
+    paged_shard: Optional[Tuple] = None,
 ):
     # returns (x, kv), or (x, kv, aux_sum) under collect_moe_aux
     """Scan the block stack. One compiled block, L iterations.  `remat=True`
@@ -463,7 +470,7 @@ def run_blocks(
             fresh_prefill=fresh_prefill, use_flash=use_flash, sp_meta=sp_meta,
             moe_impl=moe_impl,
             paged_tables=paged_tables, paged_kernel=paged_kernel,
-            paged_ragged=paged_ragged,
+            paged_ragged=paged_ragged, paged_shard=paged_shard,
         )
         return y, (k_c, v_c)
 
@@ -519,6 +526,7 @@ def forward(
     paged_tables: Optional[jnp.ndarray] = None,
     paged_kernel: Optional[bool] = None,
     paged_ragged: Optional[Tuple] = None,
+    paged_shard: Optional[Tuple] = None,
 ):
     # returns (logits, kv), or (logits, kv, aux_sum) under collect_moe_aux
     """Full-model forward: logits (B, T, padded_vocab), updated KV cache.
@@ -539,7 +547,10 @@ def forward(
     slot-major PACKED ragged batch — pass `input_pos` as the (1, T)
     per-token absolute positions (a 2-D `input_pos` overrides the
     contiguous-chunk ramp) and `paged_tables` as the full
-    (n_slots, max_blocks) table.
+    (n_slots, max_blocks) table.  `paged_shard=(mesh, tp_axis)` (the
+    tensor-parallel serving engine) routes the Pallas paged kernels
+    through a per-shard `jax.shard_map`; everything else in the paged
+    path partitions under GSPMD.
 
     `fresh_prefill` (caller contract: input_pos == 0, cache empty) attends
     over the chunk itself rather than the cache buffer, enabling the Pallas
@@ -568,7 +579,7 @@ def forward(
         sp_meta=sp_meta, moe_impl=moe_impl, unroll=unroll,
         collect_moe_aux=collect_moe_aux,
         paged_tables=paged_tables, paged_kernel=paged_kernel,
-        paged_ragged=paged_ragged,
+        paged_ragged=paged_ragged, paged_shard=paged_shard,
     )
     if collect_moe_aux:
         x, kv, aux_sum = out

@@ -121,6 +121,34 @@ try:  # pragma: no cover - import guard
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
+# Pallas calls cannot be GSPMD-partitioned, so the tensor-parallel serving
+# engine runs them per shard under jax.shard_map (the same manual-region
+# pattern as parallel/pipeline.py).  Gated like the rest of the repo's
+# shard_map users: older jax builds fall back to the lax path under a mesh.
+_HAS_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def _run_sharded_kernel(kernel_fn, mesh, axis, q, k_pool, v_pool, *scalars):
+    """Run a paged Pallas kernel per tensor-parallel shard: q splits on its
+    head axis (1), the pools on their KV-group axis (2), block tables and
+    ragged metadata replicate, and the output heads stay sharded — the
+    caller's row-parallel attn proj reduces them, which is the one
+    all-reduce per layer the dense tp forward pays.  GQA grouping survives
+    the split because n_head and G shard by the same factor (q_per_kv is
+    shard-invariant); `validate_tp_divisibility` guarantees both divide."""
+    from jax.sharding import PartitionSpec as P
+
+    q_spec = P(None, axis, None, None)
+    pool_spec = P(None, None, axis, None)
+    rep = tuple(P(*([None] * x.ndim)) for x in scalars)
+    return jax.shard_map(
+        kernel_fn,
+        mesh=mesh,
+        in_specs=(q_spec, pool_spec, pool_spec) + rep,
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k_pool, v_pool, *scalars)
+
 
 def _decode_kernel(
     # scalar prefetch
@@ -551,6 +579,8 @@ def paged_prefill(
     scale: Optional[float] = None,
     use_kernel: Optional[bool] = None,  # None → auto (TPU backend)
     interpret: bool = False,
+    shard_axes: Optional[Tuple] = None,  # (Mesh, tp_axis): run the kernel
+    # per tensor-parallel shard (heads/KV groups split, tables replicated)
 ) -> jnp.ndarray:
     """Ragged mixed prefill+decode attention over the paged pool.
 
@@ -563,20 +593,53 @@ def paged_prefill(
     — the kernel scalar-prefetches exactly that.  Packed positions no slot
     owns (batch-tail padding) return garbage rows the caller discards.
 
+    With `shard_axes` (the tensor-parallel serving engine), the kernel path
+    runs inside `jax.shard_map` over the tp axis: each device scores its
+    own head-slice against its own KV-group slice of the pool.  The lax
+    fallback needs no wrapper — it is plain jnp and GSPMD partitions it.
+
     Returns (1, n_head, T, hs).
     """
     hs = q.shape[-1]
     if scale is None:
         scale = 1.0 / (hs**0.5)
     if use_kernel is None:
-        use_kernel = _HAS_PALLAS and jax.default_backend() == "tpu"
+        use_kernel = (
+            _HAS_PALLAS
+            and jax.default_backend() == "tpu"
+            and (shard_axes is None or _HAS_SHARD_MAP)
+        )
     if use_kernel and _HAS_PALLAS:
+        if shard_axes is not None:
+            if not _HAS_SHARD_MAP:
+                raise ValueError(
+                    "paged_prefill kernel under a mesh needs jax.shard_map "
+                    "(missing in this jax build); use the lax fallback "
+                    "(use_kernel=False)"
+                )
+            mesh, axis = shard_axes
+            kern = functools.partial(
+                _shard_prefill_body, scale=scale, interpret=interpret
+            )
+            return _run_sharded_kernel(
+                kern, mesh, axis, q, k_pool, v_pool,
+                block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
+                q_len.astype(jnp.int32), q_pos.astype(jnp.int32),
+            )
         return _paged_prefill_kernel(
             q, k_pool, v_pool, block_tables, q_start, q_len, q_pos, scale,
             interpret=interpret,
         )
     return _paged_prefill_lax(
         q, k_pool, v_pool, block_tables, q_slot, q_pos, scale
+    )
+
+
+def _shard_prefill_body(q, k_pool, v_pool, tables, q_start, q_len, q_pos,
+                        *, scale, interpret):
+    return _paged_prefill_kernel(
+        q, k_pool, v_pool, tables, q_start, q_len, q_pos, scale,
+        interpret=interpret,
     )
 
 
@@ -633,6 +696,8 @@ def paged_attention(
     scale: Optional[float] = None,
     use_kernel: Optional[bool] = None,  # None → auto (TPU backend, decode)
     interpret: bool = False,
+    shard_axes: Optional[Tuple] = None,  # (Mesh, tp_axis): run the kernel
+    # per tensor-parallel shard (heads/KV groups split, tables replicated)
 ) -> jnp.ndarray:
     """Causal GQA/MQA attention through per-sequence block tables.
 
@@ -640,7 +705,8 @@ def paged_attention(
     single-query kernel; 1 < Tq <= RAGGED_KERNEL_MAX_TQ (ragged speculative
     verify: each slot scores K+1 tokens at its own depth) runs the ragged
     multi-query kernel; wider Tq (chunked prefill attending through the
-    pool) always takes the gather fallback.
+    pool) always takes the gather fallback.  With `shard_axes`, the kernel
+    paths run inside `jax.shard_map` over the tp axis (see `paged_prefill`).
     """
     hs = q.shape[-1]
     Tq = q.shape[2]
@@ -651,16 +717,36 @@ def paged_attention(
             _HAS_PALLAS
             and jax.default_backend() == "tpu"
             and Tq <= RAGGED_KERNEL_MAX_TQ
+            and (shard_axes is None or _HAS_SHARD_MAP)
         )
-    if use_kernel and _HAS_PALLAS:
-        if Tq == 1:
-            return _paged_attention_kernel(
-                q, k_pool, v_pool, block_tables, q_pos, scale,
+    if use_kernel and _HAS_PALLAS and Tq <= RAGGED_KERNEL_MAX_TQ:
+        body = (
+            _paged_attention_kernel if Tq == 1
+            else _paged_attention_ragged_kernel
+        )
+        if shard_axes is not None:
+            if not _HAS_SHARD_MAP:
+                raise ValueError(
+                    "paged_attention kernel under a mesh needs "
+                    "jax.shard_map (missing in this jax build); use the "
+                    "lax fallback (use_kernel=False)"
+                )
+            mesh, axis = shard_axes
+            kern = functools.partial(
+                _shard_attention_body, body=body, scale=scale,
                 interpret=interpret,
             )
-        if Tq <= RAGGED_KERNEL_MAX_TQ:
-            return _paged_attention_ragged_kernel(
-                q, k_pool, v_pool, block_tables, q_pos, scale,
-                interpret=interpret,
+            return _run_sharded_kernel(
+                kern, mesh, axis, q, k_pool, v_pool,
+                block_tables.astype(jnp.int32), q_pos.astype(jnp.int32),
             )
+        return body(
+            q, k_pool, v_pool, block_tables, q_pos, scale,
+            interpret=interpret,
+        )
     return _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale)
+
+
+def _shard_attention_body(q, k_pool, v_pool, tables, q_pos, *, body, scale,
+                          interpret):
+    return body(q, k_pool, v_pool, tables, q_pos, scale, interpret=interpret)

@@ -204,3 +204,21 @@ def shard_params(
 
 def batch_spec(dp_axis: str = "dp", sp_axis: Optional[str] = None) -> P:
     return P(dp_axis, sp_axis)
+
+
+def paged_kv_spec(tp_axis: Optional[str] = "tp") -> P:
+    """PartitionSpec for the serving engine's pooled block cache
+    (`transformer.init_paged_kv_cache`: k/v each (L, num_blocks, block_size,
+    G, hs)): shard the KV-group axis on `tp`, exactly like the dense
+    (L, B, G, S, hs) decode cache — each device holds its head-slice of
+    EVERY block, so the host-side allocator (block ids, free lists, prefix
+    hashes) needs no notion of devices.  Requires n_query_groups % tp == 0
+    (`validate_tp_divisibility` — n_query_groups is already in its rule
+    table; mdi-audit's `bad-serving-mesh` check mirrors it statically)."""
+    return P(None, None, None, tp_axis, None)
+
+
+def block_table_spec() -> P:
+    """Block tables ((n_slots, max_blocks) int32) are replicated: every
+    device resolves the same block ids — only the KV bytes shard."""
+    return P(None, None)

@@ -45,6 +45,23 @@ overlaps compute.  `spec_k=K` adds batched speculative decoding: per-slot
 n-gram drafts verified in one ragged multi-query forward over the paged
 cache (`ops/paged_attention.py`), emitting up to K+1 tokens per sync —
 greedy-only, exact.
+
+Tensor-parallel serving (docs/perf.md "Distributed serving"): built from a
+Generator with a tp mesh, the SAME engine serves sharded — model weights
+under `parallel/sharding.py`'s Megatron rules, the paged pool's KV-group
+axis split across chips (`paged_kv_spec`: each device holds its head-slice
+of EVERY block), while the allocator, block tables, hash-chain prefix
+cache and the scheduler stay host-side and device-count-blind.  All three
+dispatch paths (`_mixed_fn`, `_decode_chunk_fn`, `_verify_fn`) keep their
+single-device traces: GSPMD partitions the lax-fallback attention and the
+`paged_update` scatter along the sharded groups, the Pallas kernels run
+per shard under `jax.shard_map` (`ops/paged_attention.shard_axes`), and the
+only cross-chip reductions are the dense tp forward's own — one all-reduce
+per layer at the row-parallel projections, one at the sampled logits.  The
+per-request token streams stay bit-identical to the single-device engine:
+per-head attention math never crosses a shard boundary, so tp changes the
+summation layout exactly where the dense tp `generate()` path already does.
+dp/ep/sp serving meshes are rejected at `Generator.serve()` time.
 """
 
 from __future__ import annotations
@@ -76,7 +93,54 @@ from mdi_llm_tpu.ops.sampling import (
 from mdi_llm_tpu.serving.kv_pool import KVPool
 from mdi_llm_tpu.serving.scheduler import Request, Scheduler, SequenceState
 
-__all__ = ["ServingEngine", "ServingStats"]
+__all__ = ["ServingEngine", "ServingStats", "validate_serving_mesh"]
+
+
+def validate_serving_mesh(mesh) -> None:
+    """Reject meshes the serving engine cannot run, naming the offending
+    axis.  Called from `Generator.serve()` (so the error fires BEFORE any
+    pool allocation) and defensively from `ServingEngine.__init__` for
+    direct constructions.
+
+    Supported: no mesh, or a mesh whose only >1 axis is `tp` (the paged
+    pool shards its KV-group axis).  dp>1 is unsupported for serving —
+    requests are scheduler-routed, not batch-split, so a dp axis would
+    replicate the pool without serving anything on the replicas.  ep would
+    need the MoE all_to_all threaded through every serving dispatch, and
+    sp's sequence-sharded cache contradicts the pooled block layout."""
+    if mesh is None:
+        return
+    for axis in mesh.axis_names:
+        size = int(mesh.shape[axis])
+        if axis == "tp":
+            continue
+        if axis == "dp":
+            if size > 1:
+                raise ValueError(
+                    f"serving does not support dp={size}: the engine "
+                    "schedules requests into slots, not dp-split batches "
+                    "— use a tp-only mesh (or run one engine per replica)"
+                )
+            continue
+        if size > 1:
+            raise ValueError(
+                f"serving does not support a mesh with axis {axis!r} "
+                f"(size {size}): only tensor parallelism ('tp') shards "
+                "the paged pool — build the Generator with a tp-only mesh"
+            )
+
+
+def _pin_kv(kv, sharding):
+    """Pin the paged pool's sharding on a traced output (no-op off-mesh).
+    Donation keeps the buffers where they are, but without the constraint
+    GSPMD may pick a different output layout per executable — and the NEXT
+    dispatch would retrace on the new input sharding, tripping the
+    CompileGuard zero-post-warmup-recompile contract."""
+    if sharding is None:
+        return kv
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(x, sharding), kv
+    )
 
 
 @dataclass
@@ -174,17 +238,32 @@ class ServingEngine:
         engine = gen.serve(block_size=16, max_batch=8)
         engine.add_request("a", prompt_tokens, max_new_tokens=128)
         results, stats = engine.run()
+
+    Tensor-parallel: build the Generator with `mesh=make_mesh({"tp": N})`
+    and the SAME calls serve sharded (pool KV groups split over tp; see
+    the module docstring); token streams are identical to single-device.
     """
 
     def __init__(self, gen: Generator, serving: ServingConfig):
-        if gen.mesh is not None:
-            raise ValueError(
-                "ServingEngine is single-device for now (the pooled block "
-                "cache has no sharding layout); build the Generator without "
-                "a mesh"
-            )
+        validate_serving_mesh(gen.mesh)  # serve() checks too; direct
+        # constructions must hit the same wall before the pool allocates
         self.gen = gen
         self.cfg = serving
+        # tensor-parallel serving: the pool shards its KV-group axis over
+        # tp (Generator._paged_kv_sharding), the kernels run per shard
+        self._tp = int(gen.mesh.shape.get("tp", 1)) if gen.mesh is not None else 1
+        self._paged_shard = (gen.mesh, "tp") if self._tp > 1 else None
+        if (
+            self._paged_shard is not None
+            and serving.use_kernel
+            and not hasattr(jax, "shard_map")
+        ):
+            raise ValueError(
+                "use_kernel=True over a tp mesh needs jax.shard_map (the "
+                "Pallas paged kernels cannot be GSPMD-partitioned) and "
+                "this jax build lacks it; leave use_kernel unset/False "
+                "for the exact lax fallback"
+            )
         bs = serving.block_size
         if bs < 1:
             raise ValueError("block_size must be positive")
@@ -220,9 +299,9 @@ class ServingEngine:
             self.pool, serving.max_batch, serving.prefill_chunk,
             self.max_seq_length,
         )
-        self._kv = transformer.init_paged_kv_cache(
+        self._kv = gen._place_paged_kv(transformer.init_paged_kv_cache(
             gen.cfg, num_blocks, bs, dtype=gen.cache_dtype
-        )
+        ))
         # persistent host-side block table, updated incrementally as blocks
         # are appended / slots reassigned — rebuilding the full
         # (max_batch, max_blocks_per_seq) ndarray per decode dispatch was
@@ -273,6 +352,8 @@ class ServingEngine:
             use_kernel = self.cfg.use_kernel  # no self in the closure: the
             # fn cache outlives this engine (gen._serve_fns) and capturing
             # self would pin its entire paged pool for the Generator's life
+            shard = self._paged_shard
+            kv_sharding = gen._paged_kv_sharding
 
             # float knobs ride as traced operands (see _decode_fn)
             @partial(
@@ -286,7 +367,9 @@ class ServingEngine:
                     moe_impl=gen._moe_impl, unroll=gen.scan_unroll,
                     paged_tables=tables, paged_kernel=use_kernel,
                     paged_ragged=(q_slot, q_start, q_len),
+                    paged_shard=shard,
                 )
+                kv = _pin_kv(kv, kv_sharding)
                 key, sub = jax.random.split(key)
                 nxt = sample_traced(
                     logits[0, last_idx], sub, temperature, top_p,
@@ -302,6 +385,8 @@ class ServingEngine:
         if key_ not in self._fns:
             gen = self.gen
             use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
+            shard = self._paged_shard
+            kv_sharding = gen._paged_kv_sharding
 
             # float knobs ride as traced operands; the cache keys only on
             # (mode, top_k) — a per-request temperature sweep would otherwise
@@ -316,8 +401,9 @@ class ServingEngine:
                     gen.cfg, params, tok[:, None], input_pos, kv=kv,
                     rope=gen.rope, moe_impl=gen._moe_impl,
                     unroll=gen.scan_unroll, paged_tables=tables,
-                    paged_kernel=use_kernel,
+                    paged_kernel=use_kernel, paged_shard=shard,
                 )
+                kv = _pin_kv(kv, kv_sharding)
                 key, sub = jax.random.split(key)
                 nxt = sample_traced(
                     logits[:, -1], sub, temperature, top_p,
@@ -345,6 +431,8 @@ class ServingEngine:
         if key_ not in self._fns:
             gen = self.gen
             use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
+            shard = self._paged_shard
+            kv_sharding = gen._paged_kv_sharding
 
             # float knobs ride as traced operands (see _decode_fn)
             @partial(
@@ -360,8 +448,12 @@ class ServingEngine:
                         gen.cfg, params, tok[:, None], pos, kv=kv,
                         rope=gen.rope, moe_impl=gen._moe_impl,
                         unroll=gen.scan_unroll, paged_tables=tables,
-                        paged_kernel=use_kernel,
+                        paged_kernel=use_kernel, paged_shard=shard,
                     )
+                    # pin the scan carry's pool layout every step: a GSPMD
+                    # layout flip inside the loop would resharding-copy the
+                    # whole pool per iteration
+                    kv = _pin_kv(kv, kv_sharding)
                     key, sub = jax.random.split(key)
                     nxt = sample_traced(
                         logits[:, -1], sub, temperature, top_p,
@@ -398,6 +490,8 @@ class ServingEngine:
         if key_ not in self._fns:
             gen = self.gen
             use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
+            shard = self._paged_shard
+            kv_sharding = gen._paged_kv_sharding
 
             @partial(jax.jit, donate_argnums=(2,))
             def verify(params, tokens, kv, tables, pos0):
@@ -405,7 +499,9 @@ class ServingEngine:
                     gen.cfg, params, tokens, pos0, kv=kv, rope=gen.rope,
                     moe_impl=gen._moe_impl, unroll=gen.scan_unroll,
                     paged_tables=tables, paged_kernel=use_kernel,
+                    paged_shard=shard,
                 )
+                kv = _pin_kv(kv, kv_sharding)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
 
             self._fns[key_] = verify

@@ -18,6 +18,16 @@ style of vLLM's automatic prefix caching:
 Block 0 is reserved as the write-only TRASH block: padded lanes and
 bucket-padding positions scatter their garbage K/V there
 (`ops.paged_attention.paged_update`), so it is never handed out.
+
+Device-count blindness (the tensor-parallel serving contract): this
+allocator never learns how many chips back the pool.  Under a tp mesh the
+device arrays shard their KV-GROUP axis (`parallel.sharding.paged_kv_spec`
+— each device holds its head-slice of EVERY block), so block ids, free
+lists, refcounts and the hash chains are identical on 1 chip or N; only
+the bytes behind a block id shrink per device (by exactly 1/tp —
+`ServingConfig.pool_bytes_per_device`).  Sharding the BLOCK axis instead
+would have forced per-device free lists and device-aware tables; sharding
+heads keeps this file untouched by distribution.
 """
 
 from __future__ import annotations

@@ -668,14 +668,22 @@ def test_preemption_picks_latest_admitted_not_highest_slot():
     assert sched.preempted[0][0].rid == "r3"
 
 
-def test_engine_rejects_meshed_generator(served_model, devices):
+def test_engine_rejects_dp_mesh_at_serve_time(served_model, devices):
+    """Tensor-parallel meshes serve (tests/test_tp_serving.py); dp>1 is
+    the remaining exclusion and must be named at serve() time."""
     from mdi_llm_tpu.parallel.mesh import make_mesh
+    from mdi_llm_tpu.serving.engine import ServingEngine
 
     cfg, params = served_model
     gen = Generator(cfg, params, cache_dtype=jnp.float32,
                     mesh=make_mesh({"dp": 2}, jax.devices()[:2]))
-    with pytest.raises(ValueError, match="single-device"):
+    with pytest.raises(ValueError, match="dp"):
         gen.serve()
+    # direct constructions hit the same wall before the pool allocates
+    from mdi_llm_tpu.config import ServingConfig
+
+    with pytest.raises(ValueError, match="dp"):
+        ServingEngine(gen, ServingConfig())
 
 
 @pytest.mark.slow

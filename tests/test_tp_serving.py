@@ -1,0 +1,319 @@
+"""Tensor-parallel serving: the paged-KV continuous-batching engine over a
+tp GSPMD mesh must reproduce the single-device engine — and sequential
+`Generator.generate` — token-for-token across every serving feature built
+on top of it (unified mixed steps, chunked decode, speculative verify,
+preemption/resume, prefix caching), with zero post-warmup recompiles and
+the pool's KV-group axis actually sharded.
+
+The engine's hot paths are plain jnp under GSPMD, so these tests run on
+the virtual 8-device CPU platform like tests/test_tp_inference.py; only
+the Pallas-kernel-under-mesh path needs `jax.shard_map` and its tests
+skip cleanly on builds without it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import init_params
+from mdi_llm_tpu.parallel.mesh import make_mesh
+from mdi_llm_tpu.utils.profiling import CompileGuard
+from tests.test_model import CONFIG_VARIANTS, tiny_config
+
+HAS_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def single_gen(model):
+    cfg, params = model
+    return Generator(cfg, params, cache_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tp_gen(model, devices):
+    cfg, params = model
+    return Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"tp": 2}, devices[:2]),
+    )
+
+
+def _trace(cfg, lengths, seed=5):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, int(n)).tolist() for n in lengths]
+
+
+def _run_engine(gen, prompts, max_news, **knobs):
+    engine = gen.serve(**knobs)
+    for i, (p, m) in enumerate(zip(prompts, max_news)):
+        engine.add_request(f"r{i}", p, m)
+    results, stats = engine.run()
+    return results, stats, engine
+
+
+def _sequential_greedy(gen, prompts, max_news):
+    return [
+        gen.generate([p], m, temperature=0.0)[0][0]
+        for p, m in zip(prompts, max_news)
+    ]
+
+
+@pytest.mark.smoke
+def test_tp_engine_matches_single_engine_and_generate(model, single_gen, tp_gen):
+    """The acceptance contract: mixed-length trace with a token budget
+    small enough that the 33-token prompt splits across several unified
+    mixed steps — the sharded engine's streams equal BOTH the single-device
+    engine's and sequential generate()'s."""
+    cfg, _ = model
+    prompts = _trace(cfg, (3, 9, 17, 5, 33))
+    max_news = [8, 12, 6, 10, 7]
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=16, token_budget=12)
+    want_gen = _sequential_greedy(single_gen, prompts, max_news)
+    want, _, _ = _run_engine(single_gen, prompts, max_news, **knobs)
+    got, stats, engine = _run_engine(tp_gen, prompts, max_news, **knobs)
+    for i in range(len(prompts)):
+        assert got[f"r{i}"] == want[f"r{i}"], f"r{i} diverged from engine"
+        assert got[f"r{i}"] == want_gen[i], f"r{i} diverged from generate()"
+    assert stats.mixed_steps >= 4  # the long prompt split across steps
+    assert stats.requests_finished == len(prompts)
+    # the pool really is sharded: KV groups on tp, everything else resident
+    spec = engine._kv["k"].sharding.spec
+    assert "tp" in str(spec)
+    assert engine.pool.used == 0
+
+
+@pytest.mark.parametrize("chunk,buffered", [(4, True), (8, False)],
+                         ids=["k4-buffered", "k8-nobuf"])
+def test_tp_chunked_decode_token_identical(model, single_gen, tp_gen,
+                                           chunk, buffered):
+    """The multi-token serving step (K-step on-device scan, double-buffered
+    or not) over the sharded pool: token-identical, same sync amortization."""
+    cfg, _ = model
+    prompts = _trace(cfg, (3, 9, 17))
+    max_news = [8, 12, 6]
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=8,
+                 decode_chunk=chunk, double_buffer=buffered)
+    want, _, _ = _run_engine(single_gen, prompts, max_news, **knobs)
+    got, stats, _ = _run_engine(tp_gen, prompts, max_news, **knobs)
+    assert got == want
+    assert stats.tokens_per_sync > 1.0  # chunking still amortizes under tp
+
+
+def test_tp_speculative_serving_token_identical(model, single_gen, tp_gen):
+    """Batched n-gram speculative verify (ONE ragged multi-query forward
+    over the sharded pool) stays exact and still accepts drafts."""
+    cyc = [np.random.default_rng(s).integers(1, tiny_config().vocab_size,
+                                             5).tolist() for s in (5, 7, 0)]
+    max_news = [30, 25, 20]
+    knobs = dict(block_size=4, max_batch=3, decode_chunk=4, spec_k=4)
+    want, _, _ = _run_engine(single_gen, cyc, max_news, **knobs)
+    got, stats, _ = _run_engine(tp_gen, cyc, max_news, **knobs)
+    assert got == want
+    assert stats.spec_drafted > 0 and stats.spec_accepted > 0
+
+
+def test_tp_preemption_resume_parity(model, single_gen, tp_gen):
+    """A pool sized to force recompute preemption: victims resume and
+    re-feed through the sharded mixed step, outputs exact, blocks drained."""
+    cfg, _ = model
+    prompts = _trace(cfg, (9, 13, 11), seed=9)
+    max_news = [10, 10, 10]
+    knobs = dict(block_size=4, max_batch=3, max_blocks=1 + 10,
+                 prefix_caching=False, decode_chunk=4)
+    want, _, _ = _run_engine(single_gen, prompts, max_news, **knobs)
+    got, stats, engine = _run_engine(tp_gen, prompts, max_news, **knobs)
+    assert stats.preemptions >= 1, "pool was sized to force preemption"
+    assert got == want
+    assert engine.pool.used == 0
+
+
+def test_tp_prefix_cache_hits_parity(model, single_gen, tp_gen):
+    """Copy-free prefix block reuse under tp: the shared head's blocks hold
+    per-device head-slices, so reuse needs no byte movement on ANY device —
+    hits fire and the output still matches the sequential run."""
+    cfg, _ = model
+    head = _trace(cfg, (21,), seed=7)[0]
+    engine = tp_gen.serve(block_size=4, max_batch=2)
+    engine.add_request("first", head, 6)
+    engine.run()
+    tail = head + [7, 8]
+    engine.add_request("second", tail, 6)
+    results, stats = engine.run()
+    assert stats.prefix_cache_hits >= 5  # 21-token head -> 5 full blocks
+    assert results["second"] == _sequential_greedy(single_gen, [tail], [6])[0]
+
+
+def test_tp_gqa_groups_shard(devices):
+    """GQA: G=2 KV groups split one per device at tp=2 — the narrowest
+    shardable grouping — with streams identical to the unsharded engine."""
+    cfg = tiny_config(block_size=128, n_layer=3, **CONFIG_VARIANTS["gqa"])
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    prompts = _trace(cfg, (5, 12), seed=3)
+    knobs = dict(block_size=4, max_batch=2, decode_chunk=4)
+    single = Generator(cfg, params, cache_dtype=jnp.float32)
+    want, _, _ = _run_engine(single, prompts, [8, 8], **knobs)
+    tp = Generator(cfg, params, cache_dtype=jnp.float32,
+                   mesh=make_mesh({"tp": 2}, devices[:2]))
+    got, _, engine = _run_engine(tp, prompts, [8, 8], **knobs)
+    assert got == want
+    assert "tp" in str(engine._kv["k"].sharding.spec)
+
+
+def test_tp_pool_bytes_match_audit_estimate(model, tp_gen, devices):
+    """mdi-audit's per-device pool estimate must equal the LIVE sharded
+    engine's per-device pool bytes exactly — both the analytic total/tp
+    and the bytes actually resident on one device's shards."""
+    from mdi_llm_tpu.analysis.audit import preflight
+    from mdi_llm_tpu.config import ServingConfig
+
+    cfg, _ = model
+    sv = ServingConfig(block_size=4, max_batch=3, prefill_chunk=8)
+    report = preflight(cfg, tp=2, batch=3, seq_len=128,
+                       cache_dtype="float32", serving=sv)
+    assert not report.errors
+    pool = report.breakdown["kv_pool"]
+    engine = tp_gen.serve(serving=sv)
+    leaves = jax.tree_util.tree_leaves(engine._kv)
+    live_total = sum(int(x.nbytes) for x in leaves)
+    dev0 = devices[0]
+    live_dev = sum(
+        int(s.data.nbytes)
+        for x in leaves for s in x.addressable_shards if s.device == dev0
+    )
+    assert pool["tp"] == 2
+    assert pool["pool_bytes"] == live_total
+    assert pool["pool_bytes_per_device"] == live_total // 2 == live_dev
+    # the per-device HBM budget line uses the sharded number too
+    assert report.breakdown["per_device"]["kv_bytes"] == live_dev
+
+
+def test_audit_flags_bad_serving_mesh():
+    """Static twins of the runtime refusals: indivisible KV groups under
+    tp, and dp>1 serving."""
+    from mdi_llm_tpu.analysis.audit import audit_plan
+    from mdi_llm_tpu.analysis.plan import MeshSpec, PlanSpec
+    from mdi_llm_tpu.config import ServingConfig
+
+    cfg = tiny_config(block_size=128, n_layer=3, **CONFIG_VARIANTS["mqa"])
+    r = audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"tp": 2}), tp_axis="tp",
+        serving=ServingConfig(block_size=4),
+    ))
+    assert any(f.rule == "bad-serving-mesh" and "n_query_groups" in f.message
+               for f in r.findings)
+    # the byte estimate mirrors the runtime drop-indivisible rule: G=1
+    # cannot shard, so per-device == whole pool (replicated), not /tp
+    pool = r.breakdown["kv_pool"]
+    assert pool["tp"] == 1
+    assert pool["pool_bytes_per_device"] == pool["pool_bytes"]
+
+    r = audit_plan(PlanSpec(
+        cfg=tiny_config(), mesh=MeshSpec.from_dict({"dp": 2, "tp": 2}),
+        tp_axis="tp", dp_axis="dp", serving=ServingConfig(block_size=4),
+    ))
+    assert any(f.rule == "bad-serving-mesh" and "dp" in f.message
+               for f in r.findings)
+
+
+def test_serve_rejects_unsupported_mesh_axes(model, devices):
+    """Generator.serve() must refuse dp>1 and non-tp axes AT SERVE TIME,
+    naming the offending axis — not deep inside engine init."""
+    cfg, params = model
+    for axes, name in (({"dp": 2}, "dp"), ({"ep": 2}, "ep"), ({"sp": 2}, "sp")):
+        gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                        mesh=make_mesh(axes, devices[:2]))
+        with pytest.raises(ValueError, match=name):
+            gen.serve(block_size=4, max_batch=2)
+    # size-1 extra axes are harmless: tp is still the only real sharding
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"dp": 1, "tp": 2}, devices[:2]))
+    gen.serve(block_size=4, max_batch=2)
+
+
+def test_tp_engine_zero_postwarmup_recompiles(model, devices):
+    """The acceptance criterion's CompileGuard half: a warmup engine and
+    its timed twin on ONE tp Generator share the jit cache, and the timed
+    run builds no new executable — the sharding constraint pins the pool
+    layout so donation round-trips never flip it."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"tp": 2}, devices[:2]))
+    prompts = _trace(cfg, (3, 9, 17))
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=8, decode_chunk=4)
+
+    def drive(engine):
+        for i, p in enumerate(prompts):
+            engine.add_request(f"r{i}", p, 8)
+        engine.run()
+
+    guard = CompileGuard(label="tp-serve")
+    with guard:
+        drive(gen.serve(**knobs))
+        guard.mark_warm()
+        drive(gen.serve(**knobs))
+    assert guard.traces_after_warmup == 0
+    assert guard.backend_compiles_after_warmup == 0
+    guard.expect_clean()
+
+
+def test_cli_help_covers_tp_flags():
+    """Both serving front-ends document the new tensor-parallel knob."""
+    import bench
+    from mdi_llm_tpu.cli.serve import build_parser as serve_parser
+
+    serve_help = serve_parser().format_help()
+    assert "--tp" in serve_help and "tensor-parallel" in serve_help
+    bench_help = bench.build_parser().format_help()
+    assert "--tp" in bench_help and "tokens/s/chip" in bench_help
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel path under the mesh (jax.shard_map manual region)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not HAS_SHARD_MAP,
+                    reason="this jax build has no jax.shard_map (the Pallas "
+                    "paged kernels cannot run per-shard without it)")
+def test_sharded_kernel_matches_lax_fallback(devices):
+    """The shard_map-wrapped decode kernel (interpreter mode) over tp=2
+    must match the GSPMD lax fallback on the same sharded operands."""
+    from tests.test_paged_attention import build_pool, rand_qkv
+    from mdi_llm_tpu.ops.paged_attention import paged_attention
+
+    H, G, B, hs, S, bs = 4, 2, 2, 16, 32, 4
+    q, k, v = rand_qkv(B, H, G, S, hs, Tq=1, seed=3)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), bs)
+    q_pos = jnp.asarray([[13], [29]], jnp.int32)
+    mesh = make_mesh({"tp": 2}, devices[:2])
+    ref = paged_attention(q, pool_k, pool_v, tables, q_pos, use_kernel=False)
+    got = paged_attention(
+        q, pool_k, pool_v, tables, q_pos, use_kernel=True, interpret=True,
+        shard_axes=(mesh, "tp"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.skipif(HAS_SHARD_MAP,
+                    reason="jax.shard_map present: the missing-dep refusal "
+                    "gate does not apply on this build")
+def test_kernel_under_mesh_refused_without_shard_map(model, devices):
+    """On builds without jax.shard_map, an EXPLICIT use_kernel=True over a
+    mesh must refuse at engine construction with an actionable message
+    (auto use_kernel=None resolves to the lax fallback instead)."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"tp": 2}, devices[:2]))
+    with pytest.raises(ValueError, match="shard_map"):
+        gen.serve(block_size=4, max_batch=2, use_kernel=True)
+    gen.serve(block_size=4, max_batch=2)  # auto: fine, lax fallback
