@@ -524,7 +524,7 @@ def run_serve(args):
     )
     n_requests = args.serve_requests or 4 * args.batch
 
-    def build_engine():
+    def build_engine(obs=None):
         return gen.serve(
             block_size=args.serve_block_size,
             max_batch=args.batch,
@@ -533,6 +533,7 @@ def run_serve(args):
             spec_k=args.spec_k,
             double_buffer=not args.no_double_buffer,
             token_budget=args.serve_token_budget,
+            obs=obs,
         )
 
     trace = synthetic_trace(
@@ -551,7 +552,14 @@ def run_serve(args):
     warm.run()
     _mark_warm()
 
-    engine = build_engine()
+    # observe the TIMED engine only: per-request TTFT/TPOT/E2E/queue-wait
+    # percentiles ride into detail.latency (hooks fire at the engine's
+    # existing sync boundaries — zero extra syncs/compiles, so the
+    # CompileGuard row contract is untouched; docs/observability.md)
+    from mdi_llm_tpu.obs import ServingObserver
+
+    obs = ServingObserver()
+    engine = build_engine(obs=obs)
     for rid, prompt, new in trace:
         engine.add_request(rid, prompt, new)
     with contextlib.ExitStack() as stack:
@@ -566,46 +574,42 @@ def run_serve(args):
     value = total / n_chips  # tokens/s/CHIP: the cross-topology comparable
     base = baseline_for(args.model)
     tp_tag = f", tp={args.tp}" if args.tp > 1 else ""
+    # canonical serving stats (ServingStats.to_dict — same dict mdi-serve
+    # prints) + bench extras; the percentile block is the production
+    # metric tokens/s alone hides (ROADMAP item 2)
+    detail = stats.to_dict()
+    detail.update({
+        "tokens_per_s_total": round(total, 2),
+        "devices": n_chips,
+        "tp": args.tp,
+        "wall_s": round(wall, 2),  # timed region, not stats.wall_s
+        "latency": {
+            name: {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in summ.items()}
+            for name, summ in obs.latency_summaries().items()
+        },
+        "audit": audit,
+        "baseline_tokens_per_s": base,
+        "config": {
+            "model": args.model, "slots": args.batch,
+            "block_size": args.serve_block_size,
+            "token_budget": engine.token_budget,  # resolved, not the flag
+            "decode_chunk": args.serve_chunk, "spec_k": args.spec_k,
+            "double_buffer": not args.no_double_buffer,
+            "scan_unroll": args.scan_unroll,
+            "seq_len": args.seq_len, "new_tokens": args.new_tokens,
+            "requests": n_requests, "kv_dtype": args.kv_dtype,
+            "quantize": args.quantize,
+        },
+        "device": str(jax.devices()[0]),
+    })
     return {
         "metric": f"serving tokens/sec/chip ({args.model}, cb, "
                   f"slots={args.batch}, reqs={n_requests}{tp_tag})",
         "value": round(value, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(value / base, 2),
-        "detail": {
-            "tokens_generated": stats.tokens_generated,
-            "requests": stats.requests_finished,
-            "tokens_per_s_total": round(total, 2),
-            "devices": n_chips,
-            "tp": args.tp,
-            "wall_s": round(wall, 2),
-            "decode_steps": stats.decode_steps,
-            "mixed_steps": stats.mixed_steps,
-            "host_syncs": stats.host_syncs,
-            "tokens_per_sync": round(stats.tokens_per_sync, 2),
-            "padded_token_frac": round(stats.padded_token_frac, 4),
-            "mixed_batch_occupancy": round(stats.mixed_batch_occupancy, 4),
-            "spec_accept_rate": round(stats.spec_accept_rate, 4),
-            "prefill_chunks": stats.prefill_chunks,
-            "kv_block_utilization_mean": round(stats.kv_utilization_mean, 4),
-            "kv_block_utilization_peak": round(stats.kv_utilization_peak, 4),
-            "prefix_cache_hits": stats.prefix_cache_hits,
-            "preemptions": stats.preemptions,
-            "audit": audit,
-            "baseline_tokens_per_s": base,
-            "config": {
-                "model": args.model, "slots": args.batch,
-                "block_size": args.serve_block_size,
-                "token_budget": engine.token_budget,  # resolved, not the flag
-                "decode_chunk": args.serve_chunk, "spec_k": args.spec_k,
-                "double_buffer": not args.no_double_buffer,
-                "scan_unroll": args.scan_unroll,
-                "seq_len": args.seq_len, "new_tokens": args.new_tokens,
-                "requests": n_requests, "kv_dtype": args.kv_dtype,
-                "quantize": args.quantize,
-            },
-            "device": str(jax.devices()[0]),
-        },
+        "detail": detail,
     }
 
 
@@ -924,14 +928,28 @@ def run_suite(args):
     tpu_ok = False
     probe_deadline = time.perf_counter() + args.probe_timeout
     attempts = max(1, args.probe_retries + 1)
+    # per-attempt diagnostics banked into detail.probe: the r03–r05
+    # TPU→CPU fallback wedge was undiagnosable from the artifact alone
+    # (events only said "probe attempt N failed") — now every attempt
+    # records its backend, error string and elapsed time
+    probe_attempts = []
     for attempt in range(attempts):
         remaining = probe_deadline - time.perf_counter()
         if remaining <= 0:
             note(f"probe budget ({args.probe_timeout:g}s total) exhausted; "
                  "falling back")
             break
+        t_att = time.perf_counter()
         res, err = _child(["--probe"], timeout=remaining)
         det = (res or {}).get("detail", {})
+        probe_attempts.append({
+            "attempt": attempt + 1,
+            "elapsed_s": round(time.perf_counter() - t_att, 2),
+            "backend": det.get("backend"),
+            "device": det.get("device"),
+            "ok": res is not None,
+            "error": err,
+        })
         # the tunnel plugin may report its platform as "tpu" or "axon"
         if res is not None and (
             det.get("backend") in ("tpu", "axon") or "TPU" in det.get("device", "")
@@ -1026,6 +1044,12 @@ def run_suite(args):
                "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0}
     out["detail"] = {
         "rows": rows,
+        "probe": {
+            "attempts": probe_attempts,
+            "budget_s": args.probe_timeout,
+            "retries_allowed": args.probe_retries,
+            "tpu_ok": tpu_ok,
+        },
         "north_star": {
             "target": f">= {NORTH_STAR_MULTIPLE}x Jetson-class 8B baseline "
                       f"({JETSON_8B_TOKENS_PER_S} tok/s, stated in bench.py)",

@@ -472,8 +472,42 @@ def mutable_global_in_jit(mod: ModuleInfo) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# profiler hygiene for public kernels
+# timing hygiene
 # ---------------------------------------------------------------------------
+
+# wall-clock sources whose value inside a traced function is the TRACE
+# time, baked into the compiled program as a constant — not the run time
+_TIMING_FUNCS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.perf_counter_ns", "time.monotonic_ns",
+    "time.time_ns", "datetime.now", "datetime.datetime.now",
+    "datetime.utcnow", "datetime.datetime.utcnow",
+}
+
+
+@rule(
+    "timing-in-jit",
+    "wall-clock call (time.perf_counter/time.time/...) inside a jitted function "
+    "(measures trace time, not run time)",
+)
+def timing_in_jit(mod: ModuleInfo) -> Iterator[Finding]:
+    for j in mod.jitted:
+        for node in ast.walk(j.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d not in _TIMING_FUNCS:
+                continue
+            yield mod.finding(
+                "timing-in-jit",
+                node,
+                f"`{d}()` inside jitted `{j.node.name}` runs ONCE at trace "
+                "time and is baked into the executable as a constant: it "
+                "measures tracing, not the compiled run (and the steady "
+                "state never re-evaluates it) — time on the host around "
+                "the jitted call at a sync boundary (the obs/ serving "
+                "observer pattern), or use jax.profiler for device spans",
+            )
 
 # a public ops/ function whose body performs at least this many jax-namespace
 # calls is a "kernel" and must open a named_scope so device traces (and

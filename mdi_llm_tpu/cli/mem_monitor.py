@@ -4,6 +4,11 @@
 the target command, samples the process tree's RSS and (when a TPU backend
 is live in-process) `device.memory_stats()`, writes CSV + optional plot.
 
+`sample_rss` doubles as the in-process sampler behind
+`mdi-serve --sample-rss`: the serving observer calls it (rate-limited, at
+host-sync boundaries only) to expose a `host_rss_bytes` gauge
+(docs/observability.md).
+
 Example:
     python -m mdi_llm_tpu.cli.mem_monitor -o mem.csv -- \
         python -m mdi_llm_tpu.cli.sample --model NanoLlama --n-tokens 50
@@ -19,11 +24,17 @@ import time
 from pathlib import Path
 
 
-def sample_rss(proc: "subprocess.Popen") -> int:
+def sample_rss(pid: int = None) -> int:
+    """Resident-set bytes of a process TREE (pid + recursive children);
+    defaults to the calling process so in-process samplers — the serving
+    observer's `--sample-rss` host-memory gauge (`obs.ServingObserver`)
+    — share one implementation with the standalone monitor below."""
+    import os
+
     import psutil
 
     try:
-        p = psutil.Process(proc.pid)
+        p = psutil.Process(os.getpid() if pid is None else pid)
         total = p.memory_info().rss
         for child in p.children(recursive=True):
             try:
@@ -52,7 +63,7 @@ def main(argv=None):
     t0 = time.perf_counter()
     try:
         while proc.poll() is None:
-            rows.append((time.perf_counter() - t0, sample_rss(proc)))
+            rows.append((time.perf_counter() - t0, sample_rss(proc.pid)))
             time.sleep(args.interval)
     except KeyboardInterrupt:
         proc.terminate()

@@ -7,13 +7,18 @@ shared block pool, a unified token-budget step (every decode lane's
 pending token + prefill chunks in ONE ragged forward per dispatch),
 mid-batch retirement, hash-based prefix caching.  Prints each finished
 request (decoded when a tokenizer is available) and a one-line JSON stats
-summary: tokens/s, KV-block utilization, prefix-cache hits, preemptions.
+summary: tokens/s, KV-block utilization, prefix-cache hits, preemptions,
+plus the per-request latency percentile block (TTFT/TPOT/E2E/queue-wait
+p50/p95/p99).  `--metrics-out`/`--prom-out` dump the full metrics
+registry, `--trace-out` a Perfetto-loadable request/step timeline —
+all recorded at existing host-sync boundaries (docs/observability.md).
 
 Examples::
 
     # 32 mixed-length synthetic requests, 8 decode slots
     python -m mdi_llm_tpu.cli.serve --model NanoLlama --synthetic 32 \
-        --max-batch 8 --block-size 16
+        --max-batch 8 --block-size 16 \
+        --metrics-out logs/metrics.json --trace-out logs/trace.json
 
     # real prompts, one per line, against a converted checkpoint
     python -m mdi_llm_tpu.cli.serve --ckpt checkpoints/TinyLlama/... \
@@ -100,6 +105,32 @@ def build_parser():
                     "warning instead of refusing to launch")
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget for the preflight audit")
+    # observability (docs/observability.md): request-lifecycle tracing and
+    # TTFT/TPOT percentile metrics, recorded only at the engine's existing
+    # host-sync boundaries — zero extra syncs, zero recompiles
+    ap.add_argument("--metrics-out", type=Path, default=None, metavar="JSON",
+                    help="write serving metrics JSON: per-request "
+                    "TTFT/TPOT/E2E/queue-wait p50/p95/p99, counter/gauge/"
+                    "histogram registry, canonical serving stats "
+                    "(docs/observability.md metric catalog)")
+    ap.add_argument("--prom-out", type=Path, default=None, metavar="TXT",
+                    help="also write the metrics registry in Prometheus "
+                    "text exposition format")
+    ap.add_argument("--trace-out", type=Path, default=None, metavar="JSON",
+                    help="write a Chrome-trace-event timeline of the run "
+                    "(request lifecycles + engine steps) — open in "
+                    "Perfetto (ui.perfetto.dev) or chrome://tracing")
+    ap.add_argument("--trace-ring", type=int, default=65536,
+                    help="bounded ring capacity for trace events and the "
+                    "completed-request percentile window (memory stays "
+                    "O(ring) however long the engine runs)")
+    ap.add_argument("--sample-rss", type=float, default=None, nargs="?",
+                    const=0.5, metavar="SECONDS",
+                    help="sample the host process tree's RSS into a "
+                    "host_rss_bytes gauge at most once per this many "
+                    "seconds (default 0.5 when given bare), at host-sync "
+                    "boundaries only — the in-process successor to the "
+                    "standalone mem_monitor wrapper")
     return ap
 
 
@@ -192,8 +223,15 @@ def main(argv=None):
         mesh=mesh,
         scan_unroll=args.scan_unroll,
     )
+    # observability rides every run (its hooks are host-side appends at
+    # sync boundaries the loop already owns — docs/observability.md); the
+    # file flags only decide what gets WRITTEN at the end
+    from mdi_llm_tpu.obs import ServingObserver
+
+    obs = ServingObserver(ring=args.trace_ring,
+                          rss_interval_s=args.sample_rss)
     # the audited config IS the engine config — no second hand-kept copy
-    engine = gen.serve(serving=serving_cfg)
+    engine = gen.serve(serving=serving_cfg, obs=obs)
 
     if args.synthetic:
         trace = synthetic_trace(
@@ -229,27 +267,38 @@ def main(argv=None):
         else:
             print(gen_tokens)
 
-    print(json.dumps({
-        "requests": stats.requests_finished,
-        "tokens_generated": stats.tokens_generated,
-        "tokens_per_s": round(stats.tokens_per_s, 2),
+    # canonical stats (ServingStats.to_dict — the same dict bench serve
+    # rows embed) + CLI topology extras + the latency percentile block
+    line = stats.to_dict()
+    line.update({
         "tp": args.tp,
         "devices": args.tp,
         "tokens_per_s_per_chip": round(stats.tokens_per_s / max(1, args.tp), 2),
-        "wall_s": round(stats.wall_s, 2),
-        "decode_steps": stats.decode_steps,
-        "mixed_steps": stats.mixed_steps,
-        "host_syncs": stats.host_syncs,
-        "tokens_per_sync": round(stats.tokens_per_sync, 2),
-        "padded_token_frac": round(stats.padded_token_frac, 4),
-        "mixed_batch_occupancy": round(stats.mixed_batch_occupancy, 4),
-        "spec_accept_rate": round(stats.spec_accept_rate, 4),
-        "prefill_chunks": stats.prefill_chunks,
-        "kv_block_utilization_mean": round(stats.kv_utilization_mean, 4),
-        "kv_block_utilization_peak": round(stats.kv_utilization_peak, 4),
-        "prefix_cache_hits": stats.prefix_cache_hits,
-        "preemptions": stats.preemptions,
-    }), file=sys.stderr)
+        "latency": {
+            name: {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in summ.items()}
+            for name, summ in obs.latency_summaries().items()
+        },
+    })
+    print(json.dumps(line), file=sys.stderr)
+
+    if args.metrics_out:
+        args.metrics_out.parent.mkdir(parents=True, exist_ok=True)
+        args.metrics_out.write_text(
+            json.dumps(obs.metrics_dict(stats), indent=2) + "\n"
+        )
+        print(f"mdi-serve: metrics -> {args.metrics_out}", file=sys.stderr)
+    if args.prom_out:
+        args.prom_out.parent.mkdir(parents=True, exist_ok=True)
+        args.prom_out.write_text(obs.metrics.render_prometheus())
+        print(f"mdi-serve: prometheus -> {args.prom_out}", file=sys.stderr)
+    if args.trace_out:
+        obs.tracer.write_chrome_trace(args.trace_out)
+        print(
+            f"mdi-serve: trace -> {args.trace_out} "
+            "(open in https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
 
 
 if __name__ == "__main__":

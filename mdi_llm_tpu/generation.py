@@ -938,7 +938,7 @@ class Generator:
         """A stateful conversation handle with cross-turn KV reuse."""
         return ChatSession(self)
 
-    def serve(self, serving=None, **knobs):
+    def serve(self, serving=None, obs=None, **knobs):
         """A paged-KV continuous-batching engine bound to this model
         (serving/engine.py): request queue, unified token-budget steps
         (decode lanes + prefill chunks in ONE ragged forward per
@@ -956,6 +956,11 @@ class Generator:
             engine = gen.serve(block_size=16, max_batch=8)
             engine.add_request("r0", prompt_tokens, max_new_tokens=128)
             results, stats = engine.run()
+
+        `obs` takes an `obs.ServingObserver` for request-lifecycle
+        tracing and TTFT/TPOT percentile metrics — fed only at the
+        engine's existing host-sync boundaries, so enabling it changes
+        no dispatch, sync or compile behaviour (docs/observability.md).
         """
         from mdi_llm_tpu.config import ServingConfig
         from mdi_llm_tpu.serving.engine import (
@@ -970,7 +975,7 @@ class Generator:
             serving = ServingConfig(**knobs)
         elif knobs:
             raise ValueError("pass a ServingConfig or keywords, not both")
-        return ServingEngine(self, serving)
+        return ServingEngine(self, serving, obs=obs)
 
 
 

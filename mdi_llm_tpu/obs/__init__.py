@@ -1,0 +1,270 @@
+"""Serving observability: metrics, request tracing, latency percentiles.
+
+`ServingObserver` is the one object the serving stack talks to — build
+it, pass it to `Generator.serve(obs=...)` (or `ServingEngine` directly),
+and the engine/scheduler feed it at the host-sync boundaries they
+already own:
+
+    from mdi_llm_tpu.obs import ServingObserver
+
+    obs = ServingObserver()
+    engine = gen.serve(block_size=16, max_batch=8, obs=obs)
+    ...
+    results, stats = engine.run()
+    json.dump(obs.metrics_dict(stats), open("metrics.json", "w"))
+    obs.tracer.write_chrome_trace("trace.json")     # open in Perfetto
+
+It bundles three parts (docs/observability.md):
+
+- `obs.metrics`  — `MetricsRegistry`: counters/gauges/fixed-bucket
+  histograms with JSON + Prometheus exposition (`obs/metrics.py`);
+- `obs.tracer`   — `TraceRecorder`: bounded ring of request-lifecycle
+  and engine-step events, Chrome-trace/Perfetto export
+  (`obs/tracing.py`);
+- latency derivation — per-request TTFT/TPOT/E2E/queue-wait over the
+  completed-request window, aggregated to p50/p95/p99
+  (`latency_summary`).
+
+Overhead contract (pinned by tests/test_obs.py): every hook is a plain
+host-side append — enabling the observer adds ZERO extra host syncs,
+ZERO device ops and ZERO post-warmup recompiles to a serving run, and
+holds O(ring) memory however long the engine lives.  Timestamps are
+taken once per host-sync boundary and shared by everything drained
+there (`mark`), so token attribution rides syncs the engine performs
+anyway.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, Optional
+
+from mdi_llm_tpu.obs.metrics import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    latency_summary,
+    percentiles,
+)
+from mdi_llm_tpu.obs.tracing import RequestTiming, TraceRecorder
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RequestTiming",
+    "ServingObserver",
+    "TraceRecorder",
+    "LATENCY_BUCKETS_S",
+    "latency_summary",
+    "percentiles",
+]
+
+LATENCY_METRICS = ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")
+
+
+class ServingObserver:
+    """Observability hub for one serving engine (or several sharing it).
+
+    `clock` is injectable for deterministic tests; `ring` bounds both the
+    trace-event and completed-request windows; `rss_interval_s` (None =
+    off) samples the host process tree's RSS via
+    `cli.mem_monitor.sample_rss` at most once per interval, at sync
+    boundaries only (`mdi-serve --sample-rss`).
+    """
+
+    def __init__(self, ring: int = 65536,
+                 clock: Callable[[], float] = time.perf_counter,
+                 rss_interval_s: Optional[float] = None):
+        self.clock = clock
+        self.metrics = MetricsRegistry()
+        self.tracer = TraceRecorder(capacity=ring, clock=clock)
+        self.rss_interval_s = rss_interval_s
+        self._last_rss_ts: Optional[float] = None
+        self._rss_peak = 0
+        self._rss_broken = False  # psutil missing/unusable: sample once, warn
+        self._now: Optional[float] = None  # last host-sync stamp
+        self._compile_hook = None
+        # pre-register the latency histograms so an idle engine still
+        # exposes the full catalog
+        for name in LATENCY_METRICS:
+            self.metrics.histogram(
+                f"serving_request_{name.replace('_s', '_seconds')}",
+                f"per-request {name[:-2].replace('_', ' ')} distribution",
+            )
+
+    # -- host-sync boundary --------------------------------------------------
+
+    @property
+    def now(self) -> float:
+        """The last sync-boundary stamp (falls back to the clock so
+        lifecycle hooks fired outside a step still get a timestamp)."""
+        return self._now if self._now is not None else self.clock()
+
+    def step(self, kind: str, width: int, live: int,
+             t_start: Optional[float] = None,
+             kv_utilization: Optional[float] = None,
+             queue_depth: Optional[int] = None,
+             **extra) -> float:
+        """Record one engine dispatch at its host-sync boundary: stamps
+        "now" ONCE (all tokens/retirements drained at this boundary share
+        it), appends the step span, and refreshes the step gauges.
+        Returns the stamp so the engine can chain spans."""
+        prev = self._now
+        now = self.clock()
+        self._now = now
+        start = t_start if t_start is not None else (prev if prev is not None else now)
+        self.tracer.step(kind, start, now, width, live, extra or None)
+        m = self.metrics
+        m.counter("serving_steps_total",
+                  "engine dispatches (all kinds)").inc()
+        m.counter(f"serving_steps_{kind}_total",
+                  f"{kind} dispatches").inc()
+        m.counter("serving_host_syncs_total",
+                  "host reads of device results").inc()
+        m.counter("serving_tokens_dispatched_total",
+                  "device token-axis positions computed").inc(width)
+        m.gauge("serving_live_lanes", "slots carrying a sequence").set(live)
+        if kv_utilization is not None:
+            m.gauge("serving_kv_utilization",
+                    "fraction of pool blocks held by live sequences"
+                    ).set(kv_utilization)
+            peak = m.gauge("serving_kv_utilization_peak",
+                           "high-water pool utilization")
+            peak.set(max(peak.value, kv_utilization))
+        if queue_depth is not None:
+            m.gauge("serving_queue_depth",
+                    "requests waiting or preempted").set(queue_depth)
+        self._maybe_sample_rss(now)
+        return now
+
+    def _maybe_sample_rss(self, now: float) -> None:
+        if self.rss_interval_s is None or self._rss_broken:
+            return
+        if (self._last_rss_ts is not None
+                and now - self._last_rss_ts < self.rss_interval_s):
+            return
+        self._last_rss_ts = now
+        try:
+            from mdi_llm_tpu.cli.mem_monitor import sample_rss
+
+            rss = sample_rss(os.getpid())
+        except Exception:  # psutil missing: degrade to no gauge, once
+            self._rss_broken = True
+            return
+        self._rss_peak = max(self._rss_peak, rss)
+        self.metrics.gauge("host_rss_bytes",
+                           "process-tree resident set size").set(rss)
+        self.metrics.gauge("host_rss_peak_bytes",
+                           "high-water process-tree RSS").set(self._rss_peak)
+
+    # -- request lifecycle (scheduler/engine hooks) --------------------------
+
+    def request_submitted(self, rid: str, n_prompt: int,
+                          max_new_tokens: int) -> None:
+        self.tracer.request_submitted(rid, n_prompt, max_new_tokens)
+        self.metrics.counter("serving_requests_submitted_total",
+                             "requests queued").inc()
+
+    def request_admitted(self, rid: str, slot: int, admit_order: int,
+                         n_cached: int = 0, resumed: bool = False) -> None:
+        self.tracer.request_admitted(rid, slot, admit_order,
+                                     n_cached=n_cached, resumed=resumed)
+        name = ("serving_requests_resumed_total" if resumed
+                else "serving_requests_admitted_total")
+        self.metrics.counter(name, "admissions into decode slots").inc()
+        if n_cached:
+            self.metrics.counter("serving_prefix_cached_tokens_total",
+                                 "prompt tokens served from the prefix "
+                                 "cache").inc(n_cached)
+
+    def request_preempted(self, rid: str, n_generated: int) -> None:
+        self.tracer.request_preempted(rid, n_generated)
+        self.metrics.counter("serving_preemptions_total",
+                             "recompute-style preemptions").inc()
+
+    def prefill_chunk(self, rid: str, n_tokens: int) -> None:
+        self.tracer.prefill_chunk(rid, n_tokens, self.now)
+        self.metrics.counter("serving_prefill_tokens_total",
+                             "prompt tokens fed").inc(n_tokens)
+
+    def tokens(self, rid: str, n: int = 1) -> None:
+        self.tracer.tokens(rid, n, self.now)
+        self.metrics.counter("serving_tokens_generated_total",
+                             "tokens emitted to streams").inc(n)
+
+    def request_finished(self, rid: str) -> None:
+        self.tracer.request_finished(rid, self.now)
+        self.metrics.counter("serving_requests_finished_total",
+                             "requests retired complete").inc()
+        t = self.tracer.completed[-1] if self.tracer.completed else None
+        if t is None or t.rid != rid:
+            return
+        for name, v in (("ttft_s", t.ttft), ("tpot_s", t.tpot),
+                        ("e2e_s", t.e2e), ("queue_wait_s", t.queue_wait)):
+            if v is not None:
+                self.metrics.histogram(
+                    f"serving_request_{name.replace('_s', '_seconds')}"
+                ).observe(v)
+
+    # -- compile events (CompileGuard companion) -----------------------------
+
+    def attach_compile_hook(self) -> None:
+        """Count jit traces / XLA backend compiles into the registry while
+        the engine runs (utils/profiling.py's jax.monitoring listener —
+        the same event stream CompileGuard consumes)."""
+        if self._compile_hook is not None:
+            return
+        from mdi_llm_tpu.utils import profiling
+
+        traces = self.metrics.counter(
+            "jax_jit_traces_total", "jit cache misses (jaxpr traces)")
+        compiles = self.metrics.counter(
+            "jax_backend_compiles_total", "XLA backend compilations")
+
+        def hook(event: str) -> None:
+            if event == profiling._TRACE_EVENT:
+                traces.inc()
+            elif event == profiling._BACKEND_COMPILE_EVENT:
+                compiles.inc()
+
+        profiling.add_compile_listener(hook)
+        self._compile_hook = hook
+
+    def detach_compile_hook(self) -> None:
+        if self._compile_hook is None:
+            return
+        from mdi_llm_tpu.utils import profiling
+
+        profiling.remove_compile_listener(self._compile_hook)
+        self._compile_hook = None
+
+    # -- exposition ----------------------------------------------------------
+
+    def latency_summaries(self) -> Dict[str, Dict[str, float]]:
+        """{metric: {count,p50,p95,p99,mean,max}} over the
+        completed-request window — EXACT percentiles (metrics.percentiles
+        over the ring), not the histogram approximation."""
+        lats = self.tracer.latencies()
+        return {name: latency_summary(lats[name]) for name in LATENCY_METRICS}
+
+    def metrics_dict(self, stats=None) -> Dict:
+        """The `--metrics-out` JSON: latency percentile block + registry
+        snapshot (+ the engine's canonical `ServingStats.to_dict()` and
+        the per-request detail rows still in the window)."""
+        out: Dict = {
+            "latency": self.latency_summaries(),
+            "metrics": self.metrics.to_dict(),
+            "requests": [t.to_dict() for t in self.tracer.completed],
+            "ring": {"capacity": self.tracer.capacity,
+                     "events": len(self.tracer.events),
+                     "events_dropped": self.tracer.dropped,
+                     "completed_window": len(self.tracer.completed)},
+        }
+        if stats is not None:
+            out["serving_stats"] = stats.to_dict()
+        return out

@@ -62,6 +62,14 @@ per-request token streams stay bit-identical to the single-device engine:
 per-head attention math never crosses a shard boundary, so tp changes the
 summation layout exactly where the dense tp `generate()` path already does.
 dp/ep/sp serving meshes are rejected at `Generator.serve()` time.
+
+Observability (docs/observability.md): pass `obs=ServingObserver()` to
+`Generator.serve()` and the engine/scheduler report request-lifecycle
+events, per-step spans and KV/queue gauges into it — exclusively at the
+host-sync boundaries this loop already performs (the one `np.asarray`
+read per dispatch), so tracing adds zero extra syncs, zero device ops and
+zero recompiles; per-request TTFT/TPOT/E2E/queue-wait percentiles and a
+Perfetto-loadable timeline come out the other side.
 """
 
 from __future__ import annotations
@@ -229,6 +237,35 @@ class ServingStats:
     def kv_utilization_peak(self) -> float:
         return self._kv_util_peak
 
+    def to_dict(self) -> Dict[str, Any]:
+        """THE canonical JSON view of a serving run — `mdi-serve`'s stats
+        line and bench serve rows both embed exactly this dict (plus their
+        own topology/config extras), so the derived aggregates
+        (`padded_token_frac`, `tokens_per_sync`, the `_occ_*`/`_kv_util_*`
+        private sums) can never desync between surfaces.  Keys are stable:
+        suite JSON consumers key on them across rounds."""
+        return {
+            "requests": self.requests_finished,
+            "tokens_generated": self.tokens_generated,
+            "tokens_per_s": round(self.tokens_per_s, 2),
+            "wall_s": round(self.wall_s, 2),
+            "decode_s": round(self.decode_s, 3),
+            "prefill_s": round(self.prefill_s, 3),
+            "decode_steps": self.decode_steps,
+            "mixed_steps": self.mixed_steps,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_tokens": self.prefill_tokens,
+            "host_syncs": self.host_syncs,
+            "tokens_per_sync": round(self.tokens_per_sync, 2),
+            "padded_token_frac": round(self.padded_token_frac, 4),
+            "mixed_batch_occupancy": round(self.mixed_batch_occupancy, 4),
+            "spec_accept_rate": round(self.spec_accept_rate, 4),
+            "kv_block_utilization_mean": round(self.kv_utilization_mean, 4),
+            "kv_block_utilization_peak": round(self.kv_utilization_peak, 4),
+            "prefix_cache_hits": self.prefix_cache_hits,
+            "preemptions": self.preemptions,
+        }
+
 
 class ServingEngine:
     """Paged-KV continuous-batching loop bound to one `Generator`'s model.
@@ -244,11 +281,16 @@ class ServingEngine:
     the module docstring); token streams are identical to single-device.
     """
 
-    def __init__(self, gen: Generator, serving: ServingConfig):
+    def __init__(self, gen: Generator, serving: ServingConfig, obs=None):
         validate_serving_mesh(gen.mesh)  # serve() checks too; direct
         # constructions must hit the same wall before the pool allocates
         self.gen = gen
         self.cfg = serving
+        # observability (obs.ServingObserver or None): fed exclusively at
+        # the host-sync boundaries this loop already owns — enabling it
+        # adds zero device ops, zero extra syncs and zero recompiles
+        # (tests/test_obs.py pins all three; docs/observability.md)
+        self.obs = obs
         # tensor-parallel serving: the pool shards its KV-group axis over
         # tp (Generator._paged_kv_sharding), the kernels run per shard
         self._tp = int(gen.mesh.shape.get("tp", 1)) if gen.mesh is not None else 1
@@ -299,6 +341,7 @@ class ServingEngine:
             self.pool, serving.max_batch, serving.prefill_chunk,
             self.max_seq_length,
         )
+        self.scheduler.observer = obs  # lifecycle edges report from there
         self._kv = gen._place_paged_kv(transformer.init_paged_kv_cache(
             gen.cfg, num_blocks, bs, dtype=gen.cache_dtype
         ))
@@ -628,12 +671,22 @@ class ServingEngine:
         self.stats.observe_dispatch(T, off)
         self.stats.observe_mixed_occupancy(len(live), B)
         self.stats.observe_kv_utilization(self.pool.utilization)
+        if self.obs is not None:
+            # one stamp at THIS boundary; every token/retirement below
+            # shares it (the free-attribution contract)
+            self.obs.step(
+                "mixed", width=T, live=len(live), t_start=t0,
+                kv_utilization=self.pool.utilization,
+                queue_depth=self._queue_depth(), useful_tokens=off,
+            )
         any_decode = False
         for seq, n in live:
             if seq.needs_prefill:
                 seq.fed += n
                 self.stats.prefill_tokens += n
                 self.stats.prefill_chunks += 1
+                if self.obs is not None:
+                    self.obs.prefill_chunk(seq.req.rid, n)
                 if seq.fed >= seq.prefill_target:
                     # prompt (as far as it was actually FED) is in the pool:
                     # publish its full blocks for prefix reuse.  Only now —
@@ -659,11 +712,16 @@ class ServingEngine:
             self.stats.decode_steps += 1
         self.stats.prefill_s += time.perf_counter() - t0
 
+    def _queue_depth(self) -> int:
+        return len(self.scheduler.waiting) + len(self.scheduler.preempted)
+
     def _emit(self, seq: SequenceState, tok: int) -> None:
         """Append one generated token, stream it, and retire on stop/limit."""
         seq.tokens.append(tok)
         seq.next_tok = tok
         self.stats.tokens_generated += 1
+        if self.obs is not None:
+            self.obs.tokens(seq.req.rid)  # stamped at the last sync
         if self._stream_cb is not None:
             self._stream_cb(seq.req.rid, tok)
         gen_tokens = seq.generated()
@@ -722,6 +780,12 @@ class ServingEngine:
         self.stats.host_syncs += 1
         self.stats.observe_dispatch(B, len(live))
         self.stats.observe_kv_utilization(self.pool.utilization)
+        if self.obs is not None:
+            self.obs.step(
+                "decode", width=B, live=len(live), t_start=t0,
+                kv_utilization=self.pool.utilization,
+                queue_depth=self._queue_depth(),
+            )
         for seq in live:
             seq.fed += 1
             self._emit(seq, int(nxt[seq.slot]))
@@ -758,6 +822,16 @@ class ServingEngine:
         chaining another speculative chunk."""
         self.stats.host_syncs += 1
         self.stats.observe_kv_utilization(self.pool.utilization)
+        if self.obs is not None:
+            # span start defaults to the previous boundary stamp — under
+            # double-buffering the drained chunk's compute overlapped the
+            # previous read, so boundary-to-boundary IS its wall window
+            self.obs.step(
+                "decode_chunk",
+                width=self.scheduler.max_batch * self.cfg.decode_chunk,
+                live=len(live), kv_utilization=self.pool.utilization,
+                queue_depth=self._queue_depth(),
+            )
         clean = True
         for seq in live:
             if self.scheduler.slots[seq.slot] is not seq:
@@ -929,6 +1003,12 @@ class ServingEngine:
         g = np.asarray(g)
         self.stats.decode_steps += 1
         self.stats.host_syncs += 1
+        if self.obs is not None:
+            self.obs.step(
+                "verify", width=B * (K + 1), live=len(live), t_start=t0,
+                kv_utilization=self.pool.utilization,
+                queue_depth=self._queue_depth(),
+            )
         # useful side credited below per slot as len(burst) — the pending
         # row plus ACCEPTED draft rows; rejected draft rows are padding
         # (the padded_token_frac contract)
@@ -978,6 +1058,8 @@ class ServingEngine:
         """
         self._stream_cb = stream_cb
         t0 = time.perf_counter()
+        if self.obs is not None:
+            self.obs.attach_compile_hook()
         try:
             while self.scheduler.has_work:
                 if not self.step():
@@ -987,4 +1069,16 @@ class ServingEngine:
             self.stats.prefix_cache_hits = self.pool.prefix_hits
             self.stats.wall_s += time.perf_counter() - t0
             self._stream_cb = None
+            if self.obs is not None:
+                self.obs.detach_compile_hook()
+                hits = self.obs.metrics.counter(
+                    "serving_prefix_hit_blocks_total",
+                    "pool blocks reused copy-free",
+                )
+                if self.pool.prefix_hits > hits.value:  # observer may be
+                    hits.set_to(self.pool.prefix_hits)  # shared across engines
+                for k, v in self.pool.snapshot().items():
+                    self.obs.metrics.gauge(
+                        f"serving_kv_pool_{k}", f"KVPool.{k} at run end"
+                    ).set(v)
         return dict(self._results), self.stats

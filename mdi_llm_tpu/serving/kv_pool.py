@@ -79,6 +79,20 @@ class KVPool:
     def blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.block_size)
 
+    def snapshot(self) -> Dict[str, float]:
+        """Point-in-time allocator gauges for the observability layer
+        (`obs/`): pure host-side counters the pool already maintains —
+        reading them costs nothing and touches no device state."""
+        return {
+            "num_blocks": self.num_blocks,
+            "used_blocks": self.used,
+            "available_blocks": self.available,
+            "evictable_blocks": len(self._evictable),
+            "utilization": self.utilization,
+            "prefix_hits": self.prefix_hits,
+            "prefix_queries": self.prefix_queries,
+        }
+
     # -- allocation ----------------------------------------------------------
 
     def _take(self) -> Optional[int]:

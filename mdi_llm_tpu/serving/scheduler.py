@@ -107,6 +107,12 @@ class Scheduler:
         self.finished: List[SequenceState] = []
         self._admit_counter = 0  # admission recency for preemption order
         self.preemptions = 0
+        # observability hook (obs.ServingObserver or None): the scheduler
+        # owns the request lifecycle edges — submitted/admitted/resumed/
+        # preempted/retired — so it reports them; all hooks are plain
+        # host-side appends taken where the bookkeeping already happens
+        # (zero device work; see docs/observability.md)
+        self.observer = None
 
     # -- queue ---------------------------------------------------------------
 
@@ -132,6 +138,10 @@ class Scheduler:
                 f"blocks, pool has {self.pool.num_blocks - 1}"
             )
         self.waiting.append(req)
+        if self.observer is not None:
+            self.observer.request_submitted(
+                req.rid, len(req.prompt), req.max_new_tokens
+            )
 
     @property
     def has_work(self) -> bool:
@@ -170,6 +180,11 @@ class Scheduler:
         seq.admit_order = self._admit_counter
         self._admit_counter += 1
         self.slots[slot] = seq
+        if self.observer is not None:
+            self.observer.request_admitted(
+                req.rid, slot, seq.admit_order, n_cached=n_cached,
+                resumed=resume_tokens is not None,
+            )
         return seq
 
     def admit(self) -> List[SequenceState]:
@@ -201,6 +216,8 @@ class Scheduler:
         self.pool.release(seq.blocks)
         seq.blocks = []
         self.finished.append(seq)
+        if self.observer is not None:
+            self.observer.request_finished(seq.req.rid)
 
     def preempt_latest(self, exclude: Optional[SequenceState] = None) -> bool:
         """Recompute-style preemption: kick the most recently admitted
@@ -223,6 +240,8 @@ class Scheduler:
             toks.append(seq.next_tok)
         self.preempted.appendleft((seq.req, toks))
         self.preemptions += 1
+        if self.observer is not None:
+            self.observer.request_preempted(seq.req.rid, seq.n_generated)
         return True
 
     def ensure_blocks_for(self, seq: SequenceState, n_writes: int = 1) -> bool:

@@ -10,7 +10,9 @@ Perfetto) — the TPU-native upgrade called out in SURVEY.md §7.
 `jax.monitoring`, so a bench run can PROVE the steady state — after
 warmup, a hot decode loop must never compile again.  bench.py fails its
 decode rows on any post-warmup recompile and records the counts in every
-row's `detail.compiles` (docs/perf.md "Compile stability").
+row's `detail.compiles` (docs/perf.md "Compile stability").  The same
+event stream also feeds the serving observability layer's compile
+counters via `add_compile_listener` (`obs/`, docs/observability.md).
 """
 
 from __future__ import annotations
@@ -31,12 +33,15 @@ _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _active_guards: List["CompileGuard"] = []
+_compile_listeners: List = []  # obs-layer hooks: fn(event_key) per event
 _listener_installed = False
 
 
 def _dispatch_event(event: str, duration: float, **kwargs) -> None:
     for guard in _active_guards:
         guard._observe(event)
+    for fn in _compile_listeners:
+        fn(event)
 
 
 def _install_listener() -> None:
@@ -49,6 +54,25 @@ def _install_listener() -> None:
 
     monitoring.register_event_duration_secs_listener(_dispatch_event)
     _listener_installed = True
+
+
+def add_compile_listener(fn) -> None:
+    """Subscribe `fn(event_key)` to the same jax.monitoring compile-event
+    stream CompileGuard counts (`_TRACE_EVENT` per jit cache miss,
+    `_BACKEND_COMPILE_EVENT` per XLA compile).  The obs layer uses this to
+    feed compile counters into a `MetricsRegistry` without owning a guard;
+    pair with `remove_compile_listener` (try/finally) — the listener list
+    is process-global."""
+    _install_listener()
+    if fn not in _compile_listeners:
+        _compile_listeners.append(fn)
+
+
+def remove_compile_listener(fn) -> None:
+    try:
+        _compile_listeners.remove(fn)
+    except ValueError:
+        pass
 
 
 class RecompileError(RuntimeError):

@@ -218,6 +218,48 @@ def test_probe_failures_respect_retry_budget(monkeypatch):
     assert len(probes) == 5
 
 
+def test_probe_attempt_diagnostics_in_suite_json(monkeypatch):
+    """Every probe attempt records backend/error/elapsed in detail.probe:
+    the r03–r05 TPU→CPU fallback wedge was undiagnosable from the suite
+    artifact alone (events only said "attempt N failed") — the artifact
+    must now carry WHY each attempt failed."""
+    calls = []
+
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            calls.append(timeout)
+            if len(calls) == 1:
+                return None, "timeout"
+            return _probe_ok(), None
+        return _row(2700.0), None
+
+    out = run_suite_with(monkeypatch, child, probe_retries=1)
+    probe = out["detail"]["probe"]
+    assert probe["tpu_ok"] is True
+    assert probe["budget_s"] == 5.0 and probe["retries_allowed"] == 1
+    a1, a2 = probe["attempts"]
+    assert a1["attempt"] == 1 and a1["ok"] is False
+    assert a1["error"] == "timeout" and a1["backend"] is None
+    assert "elapsed_s" in a1
+    assert a2["ok"] is True and a2["backend"] == "tpu"
+    assert a2["device"] == "TPU v5 lite0" and a2["error"] is None
+    json.dumps(out)
+
+    # the never-up path banks its failed attempts too
+    calls.clear()
+
+    def child_dead(argv, timeout, env=None):
+        if "--probe" in argv:
+            return None, "backend: Unable to initialize backend"
+        return _row(0.7), None
+
+    out = run_suite_with(monkeypatch, child_dead, probe_retries=0)
+    probe = out["detail"]["probe"]
+    assert probe["tpu_ok"] is False
+    assert len(probe["attempts"]) == 1
+    assert "Unable to initialize" in probe["attempts"][0]["error"]
+
+
 def test_probe_budget_is_a_hard_total_cap(monkeypatch):
     """BENCH_r05 burned 900 s because each probe attempt got the full
     budget again (events showed attempts still starting at t=420 s and

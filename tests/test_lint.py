@@ -116,6 +116,16 @@ TABLE = {"scale": 2.0}
 def f(x):
     return x * TABLE["scale"]
 """,
+    "timing-in-jit": """
+import time
+import jax
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()   # runs ONCE, at trace time
+    y = x * 2
+    return y, time.time() - t0
+""",
     "missing-named-scope": """
 import jax
 import jax.numpy as jnp
@@ -224,6 +234,19 @@ SCALE = 2.0  # immutable module constant: fine
 @jax.jit
 def f(x, table):
     return x * table["scale"] * SCALE
+""",
+    "timing-in-jit": """
+import time
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def timed_step(x):
+    t0 = time.perf_counter()   # host side, at the dispatch boundary: fine
+    y = step(x)
+    return y, time.perf_counter() - t0
 """,
     "missing-named-scope": """
 import jax

@@ -1,0 +1,501 @@
+"""Serving observability (`mdi_llm_tpu/obs/`): percentile math against a
+fake clock, Chrome-trace schema/ordering, ring-buffer bounding, and the
+overhead contract — with tracing + metrics enabled, a full mixed serving
+trace shows ZERO post-warmup recompiles and an UNCHANGED host_syncs count
+vs observability off (the acceptance criteria of the obs layer: it is a
+serving feature precisely because enabling it cannot perturb serving).
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import init_params
+from mdi_llm_tpu.obs import (
+    Counter,
+    Histogram,
+    MetricsRegistry,
+    ServingObserver,
+    TraceRecorder,
+    latency_summary,
+    percentiles,
+)
+from tests.test_model import tiny_config
+
+
+class FakeClock:
+    """Deterministic, manually-advanced clock for timestamp math."""
+
+    def __init__(self, t0: float = 100.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# metrics primitives
+# ---------------------------------------------------------------------------
+
+
+def test_counter_is_monotonic():
+    c = Counter("c")
+    c.inc()
+    c.inc(3)
+    assert c.value == 4
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    c.set_to(10)
+    with pytest.raises(ValueError):
+        c.set_to(4)
+
+
+def test_percentiles_exact_match_numpy_linear():
+    rng = np.random.default_rng(3)
+    values = rng.uniform(0, 5, 37).tolist()
+    for q in (0, 10, 50, 95, 99, 100):
+        ours = percentiles(values, [q])[0]
+        ref = float(np.percentile(values, q))  # default 'linear' method
+        assert math.isclose(ours, ref, rel_tol=1e-12), (q, ours, ref)
+    assert percentiles([], [50]) == [0.0]
+    with pytest.raises(ValueError):
+        percentiles([1.0], [101])
+
+
+def test_latency_summary_block_shape():
+    s = latency_summary([0.1, 0.2, 0.3, 0.4])
+    assert set(s) == {"count", "p50", "p95", "p99", "mean", "max"}
+    assert s["count"] == 4 and math.isclose(s["p50"], 0.25)
+    assert math.isclose(s["mean"], 0.25) and s["max"] == 0.4
+    empty = latency_summary([])
+    assert empty["count"] == 0 and empty["p50"] == 0.0
+
+
+def test_histogram_buckets_and_percentile():
+    h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    assert h.count == 5 and h.sum == pytest.approx(106.5)
+    assert h.counts == [1, 2, 1, 1]  # per-bucket + overflow
+    cum = h.cumulative()
+    assert cum[:3] == [(1.0, 1), (2.0, 3), (4.0, 4)]
+    assert cum[-1] == (math.inf, 5)
+    # interpolated estimate lands inside the containing bucket
+    assert 1.0 <= h.percentile(50) <= 2.0
+    assert h.percentile(0) == 0.0 or h.percentile(0) <= 1.0
+    with pytest.raises(ValueError):
+        Histogram("bad", buckets=(2.0, 1.0))
+
+
+def test_registry_exposition_json_and_prometheus():
+    r = MetricsRegistry()
+    r.counter("reqs_total", "requests").inc(3)
+    r.gauge("util", "pool util").set(0.5)
+    h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    d = r.to_dict()
+    assert d["counters"]["reqs_total"] == 3
+    assert d["gauges"]["util"] == 0.5
+    hd = d["histograms"]["lat_seconds"]
+    assert hd["count"] == 2 and hd["buckets"][-1][0] == "+Inf"
+    json.dumps(d)  # JSON-clean (inf encoded as the "+Inf" string)
+
+    text = r.render_prometheus()
+    assert "# TYPE reqs_total counter" in text
+    assert "reqs_total 3" in text
+    assert '# TYPE lat_seconds histogram' in text
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "lat_seconds_sum" in text and "lat_seconds_count 2" in text
+
+    # get-or-create returns the same object; type conflicts refuse
+    assert r.counter("reqs_total") is r.counter("reqs_total")
+    with pytest.raises(TypeError):
+        r.gauge("reqs_total")
+
+
+# ---------------------------------------------------------------------------
+# fake-clock lifecycle -> latency percentiles (the derivation under test)
+# ---------------------------------------------------------------------------
+
+
+def test_request_latency_derivation_against_fake_clock():
+    """Drive one request through the full lifecycle on a fake clock and
+    check every derived latency by hand: queue-wait = admit - submit,
+    TTFT = first token - submit, TPOT = (last - first)/(n - 1),
+    E2E = finish - submit."""
+    clk = FakeClock(1000.0)
+    obs = ServingObserver(ring=64, clock=clk)
+    obs.request_submitted("r0", n_prompt=7, max_new_tokens=4)
+    clk.advance(2.0)
+    obs.request_admitted("r0", slot=0, admit_order=0)
+    clk.advance(1.0)
+    obs.step("mixed", width=16, live=1)  # prefill chunk boundary
+    obs.prefill_chunk("r0", 7)
+    clk.advance(0.5)
+    obs.step("mixed", width=16, live=1)  # prefill completes, first token
+    obs.tokens("r0")
+    for _ in range(3):
+        clk.advance(0.25)
+        obs.step("decode", width=1, live=1)
+        obs.tokens("r0")
+    obs.request_finished("r0")
+
+    t = obs.tracer.completed[0]
+    assert t.queue_wait == pytest.approx(2.0)
+    assert t.ttft == pytest.approx(3.5)  # 2.0 queue + 1.0 + 0.5 to token 1
+    assert t.n_tokens == 4
+    assert t.tpot == pytest.approx(0.75 / 3)  # 3 gaps of 0.25 s
+    assert t.e2e == pytest.approx(4.25)
+    assert t.prefill_chunks == 1
+
+
+def test_percentile_aggregation_over_many_fake_requests():
+    """N requests with arithmetically spread latencies: the summaries'
+    p50/p95/p99 must equal the hand-computed order statistics (exact
+    percentiles over the completed-request window, NOT the histogram
+    approximation)."""
+    clk = FakeClock(0.0)
+    obs = ServingObserver(ring=256, clock=clk)
+    n = 20
+    for i in range(n):
+        rid = f"r{i}"
+        t_submit = clk.t
+        obs.request_submitted(rid, n_prompt=4, max_new_tokens=2)
+        clk.advance(0.1 * (i + 1))  # queue wait: 0.1, 0.2, ... 2.0
+        obs.request_admitted(rid, slot=0, admit_order=i)
+        obs.step("mixed", width=8, live=1)
+        obs.tokens(rid)  # TTFT == queue wait (token at admit instant)
+        clk.advance(0.05)
+        obs.step("decode", width=1, live=1)
+        obs.tokens(rid)
+        obs.request_finished(rid)
+        assert obs.tracer.completed[-1].ttft == pytest.approx(
+            clk.t - t_submit - 0.05
+        )
+        clk.advance(1.0)  # inter-arrival gap
+    summ = obs.latency_summaries()
+    waits = [0.1 * (i + 1) for i in range(n)]
+    want50, want95, want99 = percentiles(waits, (50, 95, 99))
+    assert summ["queue_wait_s"]["count"] == n
+    assert summ["queue_wait_s"]["p50"] == pytest.approx(want50)
+    assert summ["queue_wait_s"]["p95"] == pytest.approx(want95)
+    assert summ["queue_wait_s"]["p99"] == pytest.approx(want99)
+    assert summ["ttft_s"]["p50"] == pytest.approx(want50)
+    assert summ["tpot_s"]["p99"] == pytest.approx(0.05)
+    # every e2e = wait + 0.05
+    assert summ["e2e_s"]["p95"] == pytest.approx(want95 + 0.05)
+
+
+def test_preemption_and_resume_recorded():
+    clk = FakeClock()
+    obs = ServingObserver(ring=64, clock=clk)
+    obs.request_submitted("r0", 4, 8)
+    obs.request_admitted("r0", slot=0, admit_order=0)
+    obs.step("decode", width=1, live=1)
+    obs.tokens("r0")
+    obs.request_preempted("r0", n_generated=1)
+    clk.advance(1.0)
+    obs.request_admitted("r0", slot=1, admit_order=1, resumed=True)
+    obs.step("decode", width=1, live=1)
+    obs.tokens("r0")
+    obs.request_finished("r0")
+    t = obs.tracer.completed[0]
+    assert t.preemptions == 1
+    assert t.admit_order == 0  # queue-wait keys on the FIRST admission
+    names = [e["name"] for e in obs.tracer.events]
+    assert "preempted" in names and "resumed" in names
+    m = obs.metrics.to_dict()["counters"]
+    assert m["serving_preemptions_total"] == 1
+    assert m["serving_requests_resumed_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ring bounding
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ring_is_bounded():
+    clk = FakeClock()
+    rec = TraceRecorder(capacity=8, clock=clk)
+    for i in range(30):
+        rec.instant(f"e{i}", clk.advance(0.1), pid=1, tid=0)
+    assert len(rec.events) == 8
+    assert rec.dropped == 22
+    # the ring keeps the NEWEST events
+    assert [e["name"] for e in rec.events] == [f"e{i}" for i in range(22, 30)]
+    # the completed-request window is bounded by the same capacity
+    obs = ServingObserver(ring=4, clock=clk)
+    for i in range(10):
+        rid = f"r{i}"
+        obs.request_submitted(rid, 1, 1)
+        obs.request_admitted(rid, slot=0, admit_order=i)
+        obs.tokens(rid)
+        obs.request_finished(rid)
+    assert len(obs.tracer.completed) == 4
+    assert [t.rid for t in obs.tracer.completed] == ["r6", "r7", "r8", "r9"]
+    assert obs.latency_summaries()["e2e_s"]["count"] == 4
+    with pytest.raises(ValueError):
+        TraceRecorder(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export: schema + admission-order reconstruction
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _mixed_trace(cfg, seed=5, lens=(3, 9, 17, 5, 33), news=(8, 12, 6, 10, 7)):
+    rng = np.random.default_rng(seed)
+    return [
+        (f"r{i}", rng.integers(1, cfg.vocab_size, int(n)).tolist(), m)
+        for i, (n, m) in enumerate(zip(lens, news))
+    ]
+
+
+def _run_engine(cfg, params, obs=None, **knobs):
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    knobs.setdefault("block_size", 4)
+    knobs.setdefault("max_batch", 3)
+    knobs.setdefault("prefill_chunk", 8)
+    engine = gen.serve(obs=obs, **knobs)
+    for rid, prompt, new in _mixed_trace(cfg):
+        engine.add_request(rid, prompt, new)
+    return engine.run()
+
+
+def test_chrome_trace_schema_and_admission_order(served_model, tmp_path):
+    cfg, params = served_model
+    obs = ServingObserver(ring=4096)
+    results, stats = _run_engine(cfg, params, obs=obs)
+    assert stats.requests_finished == 5
+
+    out = tmp_path / "trace.json"
+    obs.tracer.write_chrome_trace(out)
+    doc = json.loads(out.read_text())  # valid JSON end to end
+    events = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["events_dropped"] == 0
+
+    for e in events:
+        assert {"name", "ph", "pid", "tid"} <= set(e), e
+        assert e["ph"] in ("X", "i", "M"), e
+        if e["ph"] != "M":
+            assert e["ts"] >= 0, "timestamps rebased to the trace epoch"
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+
+    # per-request spans reconstruct the scheduler's admission order: span
+    # start times sort identically to admit_order, and the track metadata
+    # pins the same rank
+    spans = sorted(
+        (e for e in events if e["ph"] == "X" and e["pid"] == 1),
+        key=lambda e: e["ts"],
+    )
+    assert len(spans) == 5
+    orders = [e["args"]["admit_order"] for e in spans]
+    assert orders == sorted(orders) == list(range(5))
+    assert [e["tid"] for e in spans] == orders
+    sort_meta = {
+        e["tid"]: e["args"]["sort_index"]
+        for e in events if e["name"] == "thread_sort_index"
+    }
+    assert sort_meta == {i: i for i in range(5)}
+    # spans carry the latency attribution for Perfetto inspection
+    for e in spans:
+        assert e["args"]["ttft_s"] > 0 and e["args"]["n_tokens"] > 0
+    # engine steps ride on their own process lane with packing detail
+    steps = [e for e in events if e["ph"] == "X" and e["pid"] == 2]
+    assert steps and all(
+        e["args"]["packed_width"] > 0 and e["args"]["live_lanes"] >= 1
+        for e in steps
+    )
+    assert {e["name"] for e in steps} <= {
+        "mixed", "decode", "decode_chunk", "verify"
+    }
+
+
+def test_open_request_spans_exported_mid_run(served_model):
+    """A live engine snapshot must render: requests admitted but not yet
+    retired export partial spans up to 'now'."""
+    cfg, params = served_model
+    obs = ServingObserver(ring=1024)
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    # decode_chunk=1 pins the per-step engine (a buffered chunk loop would
+    # drain the whole request inside one step() call)
+    engine = gen.serve(block_size=4, max_batch=2, prefill_chunk=8,
+                       decode_chunk=1, obs=obs)
+    rng = np.random.default_rng(0)
+    engine.add_request("open", rng.integers(1, cfg.vocab_size, 5).tolist(), 30)
+    for _ in range(3):
+        engine.step()
+    assert engine.scheduler.has_work  # still mid-request
+    doc = obs.tracer.to_chrome_trace()
+    open_spans = [
+        e for e in doc["traceEvents"]
+        if e.get("ph") == "X" and e.get("args", {}).get("open")
+    ]
+    assert len(open_spans) == 1 and open_spans[0]["name"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# the overhead contract: zero recompiles, zero extra host syncs
+# ---------------------------------------------------------------------------
+
+
+def test_observability_adds_no_syncs_no_recompiles(served_model):
+    """THE acceptance test: on a full mixed serving trace (prefill splits,
+    chunked decode, retirement) enabling tracing + metrics changes
+    NOTHING the device sees — token streams identical, host_syncs count
+    identical, and zero post-warmup recompiles with the CompileGuard
+    pinned across the observed run."""
+    from mdi_llm_tpu.utils.profiling import CompileGuard
+
+    cfg, params = served_model
+    # one Generator: its _serve_fns cache is the warmup boundary
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+
+    def run(obs):
+        engine = gen.serve(block_size=4, max_batch=3, prefill_chunk=8,
+                           obs=obs)
+        for rid, prompt, new in _mixed_trace(cfg):
+            engine.add_request(rid, prompt, new)
+        return engine.run()
+
+    guard = CompileGuard(label="obs-overhead")
+    with guard:
+        results_off, stats_off = run(None)  # warmup: compiles allowed
+        guard.mark_warm()
+        obs = ServingObserver(ring=4096, rss_interval_s=0.0)
+        results_on, stats_on = run(obs)
+    guard.expect_clean()  # zero post-warmup recompiles with obs enabled
+
+    assert results_on == results_off, "observability perturbed the streams"
+    assert stats_on.host_syncs == stats_off.host_syncs, \
+        "observability added host syncs"
+    assert stats_on.decode_steps == stats_off.decode_steps
+    assert stats_on.mixed_steps == stats_off.mixed_steps
+
+    # the observer's own counters agree with the engine's aggregates
+    c = obs.metrics.to_dict()["counters"]
+    assert c["serving_host_syncs_total"] == stats_on.host_syncs
+    assert c["serving_tokens_generated_total"] == stats_on.tokens_generated
+    assert c["serving_requests_finished_total"] == stats_on.requests_finished
+    assert c["serving_prefill_tokens_total"] == stats_on.prefill_tokens
+    # compile counters rode the same jax.monitoring stream the guard uses:
+    # the observed run compiled nothing
+    assert c["jax_jit_traces_total"] == 0
+    # the latency block is fully populated for every finished request
+    summ = obs.latency_summaries()
+    for name in ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s"):
+        assert summ[name]["count"] == 5, name
+        assert summ[name]["p99"] >= summ[name]["p50"] >= 0
+    # RSS sampling was on (interval 0 = every boundary) and found a gauge
+    assert obs.metrics.to_dict()["gauges"].get("host_rss_bytes", 0) > 0
+
+
+def test_stats_to_dict_is_canonical(served_model):
+    """ServingStats.to_dict is the one JSON view both mdi-serve and bench
+    embed: derived aggregates must match the properties exactly."""
+    cfg, params = served_model
+    _, stats = _run_engine(cfg, params)
+    d = stats.to_dict()
+    assert d["requests"] == stats.requests_finished
+    assert d["tokens_generated"] == stats.tokens_generated
+    assert d["host_syncs"] == stats.host_syncs
+    assert d["tokens_per_sync"] == round(stats.tokens_per_sync, 2)
+    assert d["padded_token_frac"] == round(stats.padded_token_frac, 4)
+    assert d["mixed_batch_occupancy"] == round(stats.mixed_batch_occupancy, 4)
+    assert d["kv_block_utilization_peak"] == round(stats.kv_utilization_peak, 4)
+    json.dumps(d)
+    # private aggregates stay private: no underscore keys leak
+    assert not [k for k in d if k.startswith("_")]
+
+
+def test_engine_preemption_feeds_lifecycle_events(served_model):
+    """A pool sized to force preemption emits preempted/resumed edges and
+    per-request preemption counts through the REAL engine path."""
+    cfg, params = served_model
+    obs = ServingObserver(ring=2048)
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = gen.serve(block_size=4, max_batch=3, max_blocks=1 + 14,
+                       prefix_caching=False, decode_chunk=1, obs=obs)
+    rng = np.random.default_rng(9)
+    for i, n in enumerate((9, 13, 11)):
+        engine.add_request(
+            f"r{i}", rng.integers(1, cfg.vocab_size, int(n)).tolist(), 10
+        )
+    _, stats = engine.run()
+    assert stats.preemptions > 0, "pool sized to preempt"
+    c = obs.metrics.to_dict()["counters"]
+    assert c["serving_preemptions_total"] == stats.preemptions
+    assert c["serving_requests_resumed_total"] >= 1
+    assert sum(t.preemptions for t in obs.tracer.completed) == stats.preemptions
+
+
+def test_serve_cli_exposes_observability_flags():
+    from mdi_llm_tpu.cli.serve import build_parser
+
+    help_text = build_parser().format_help()
+    for flag in ("--metrics-out", "--trace-out", "--prom-out",
+                 "--trace-ring", "--sample-rss"):
+        assert flag in help_text, flag
+    assert "Perfetto" in help_text
+
+
+@pytest.mark.slow
+def test_serve_cli_writes_metrics_and_trace_artifacts(tmp_path):
+    """mdi-serve end-to-end on a synthetic mixed trace: the metrics JSON
+    carries TTFT/TPOT/E2E/queue-wait p50/p95/p99 and the trace file is
+    Perfetto-loadable with per-request spans in admission order — the
+    CLI half of the acceptance criteria."""
+    from mdi_llm_tpu.cli.serve import main as serve_main
+
+    metrics_p = tmp_path / "metrics.json"
+    trace_p = tmp_path / "trace.json"
+    prom_p = tmp_path / "metrics.prom"
+    serve_main([
+        "--model", "pythia-14m", "--synthetic", "6", "--n-tokens", "8",
+        "--sequence-length", "64", "--max-batch", "3", "--block-size", "8",
+        "--device", "cpu",
+        "--metrics-out", str(metrics_p), "--trace-out", str(trace_p),
+        "--prom-out", str(prom_p), "--sample-rss", "0.0",
+    ])
+    m = json.loads(metrics_p.read_text())
+    for name in ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s"):
+        blk = m["latency"][name]
+        assert blk["count"] == 6
+        assert blk["p99"] >= blk["p95"] >= blk["p50"] >= 0.0
+    assert m["serving_stats"]["requests"] == 6  # canonical to_dict embed
+    assert m["metrics"]["counters"]["serving_requests_finished_total"] == 6
+    assert m["metrics"]["gauges"].get("host_rss_bytes", 0) > 0
+    assert "serving_request_ttft_seconds" in m["metrics"]["histograms"]
+
+    doc = json.loads(trace_p.read_text())
+    spans = sorted(
+        (e for e in doc["traceEvents"]
+         if e.get("ph") == "X" and e["pid"] == 1),
+        key=lambda e: e["ts"],
+    )
+    orders = [e["args"]["admit_order"] for e in spans]
+    assert len(orders) == 6 and orders == sorted(orders)
+
+    text = prom_p.read_text()
+    assert "# TYPE serving_requests_finished_total counter" in text
+    assert 'serving_request_ttft_seconds_bucket{le="+Inf"} 6' in text

@@ -713,3 +713,10 @@ def test_bench_serving_row_cpu_fallback():
     assert d["tokens_per_sync"] >= 8, "chunked serving must amortize syncs"
     assert d["compiles"]["traces_after_warmup"] == 0
     assert d["compiles"]["backend_compiles_after_warmup"] == 0
+    # the percentile block rides every serve row (the production metrics
+    # tokens/s alone hides — docs/observability.md); per-request count ==
+    # finished requests, ordering sane
+    for name in ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s"):
+        blk = d["latency"][name]
+        assert blk["count"] == 8, name
+        assert blk["p99"] >= blk["p95"] >= blk["p50"] >= 0.0
