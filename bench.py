@@ -41,6 +41,7 @@ import os
 import subprocess
 import sys
 import time
+from functools import partial
 
 REFERENCE_TOKENS_PER_S = 7.0  # 3×Jetson TX2, TinyLlama, from the plot
 JETSON_8B_TOKENS_PER_S = 40.0  # stated stand-in: AGX Orin Llama-3-8B int4
@@ -127,7 +128,15 @@ def build_parser():
     )
     ap.add_argument("--dtype", choices=("bfloat16", "float16", "float32"), default="bfloat16")
     ap.add_argument("--quantize", choices=("none", "int8", "w8a8", "int4"), default="none")
-    ap.add_argument("--kv-dtype", choices=("auto", "bfloat16", "float16", "float32", "float8"), default="auto")
+    ap.add_argument("--kv-dtype",
+                    choices=("auto", "bfloat16", "float16", "float32",
+                             "float8", "int8"),
+                    default="auto",
+                    help="KV storage dtype; int8 (serve/kernel modes) "
+                    "quantizes the paged pool — int8 blocks with per-block-"
+                    "per-head scales dequantized inside the attention "
+                    "kernels, ~2x pool blocks per HBM byte (docs/perf.md "
+                    "'Quantized paged KV')")
     # decode default 256 measured 2283 tok/s/chip vs 2133 at 128 (v5e, r3):
     # longer scans amortize the host sync between dispatches.  Pipeline mode
     # defaults to 16: surplus ring rotations after a mid-chunk sample finish
@@ -138,7 +147,8 @@ def build_parser():
         "steady-state ring rotations per jit call, default 16)",
     )
     ap.add_argument(
-        "--mode", choices=("decode", "prefill", "train", "serve"), default="decode",
+        "--mode", choices=("decode", "prefill", "train", "serve", "kernel"),
+        default="decode",
         help="prefill: compare flash-attention prefill latency vs the XLA "
         "path at --prompt-len and verify greedy-token agreement; "
         "train: time optimizer steps on synthetic data (tokens/s + MFU) — "
@@ -147,7 +157,11 @@ def build_parser():
         "serve: continuous-batching throughput over the paged KV pool on a "
         "mixed-length synthetic request trace (tokens/s + KV-block "
         "utilization; --batch = decode slots, --new-tokens = per-request "
-        "output ceiling)",
+        "output ceiling); "
+        "kernel: paged-attention microbench — Pallas kernel vs gather "
+        "fallback vs dense attention for decode/ragged-verify/ragged-"
+        "prefill dispatch shapes at fp AND int8 (the in-kernel dequant "
+        "cost measured, not asserted; kernel timings need a TPU backend)",
     )
     ap.add_argument("--serve-requests", type=int, default=None,
                     help="serve mode: queued requests (default 4x --batch)")
@@ -164,6 +178,13 @@ def build_parser():
     ap.add_argument("--spec-k", type=int, default=0,
                     help="serve mode: n-gram speculative draft length "
                     "(greedy only; 0 disables)")
+    ap.add_argument("--serve-pool-mib", type=float, default=None,
+                    help="serve mode: cap the KV pool at this many MiB — "
+                    "max_blocks = budget // itemized bytes-per-block "
+                    "(ServingConfig.block_bytes, scale arrays included), "
+                    "so fp and int8 rows at the same budget compare "
+                    "resident capacity at EQUAL pool bytes (default: "
+                    "full coverage, no cap)")
     ap.add_argument("--tp", type=int, default=1,
                     help="serve mode: tensor-parallel devices — the model "
                     "shards under the Megatron rules and the paged KV "
@@ -199,6 +220,40 @@ def build_parser():
 # ---------------------------------------------------------------------------
 
 
+def _serve_config(args, cfg, kv_dtype=...):
+    """THE ServingConfig a serve row runs — preflight, warmup engine and
+    timed engine all read this one builder so they can never disagree.
+
+    --kv-dtype int8 selects the quantized pool (ServingConfig.kv_dtype);
+    --serve-pool-mib converts a byte budget into max_blocks through the
+    itemized `ServingConfig.block_bytes` (payload + int8 scale arrays), so
+    an fp and an int8 row at the same budget hold the same pool BYTES and
+    differ only in how many blocks those bytes buy (pass `kv_dtype=None`
+    to build the fp twin of an int8 row at the same budget)."""
+    from mdi_llm_tpu.config import ServingConfig
+
+    if kv_dtype is ...:
+        kv_dtype = "int8" if args.kv_dtype == "int8" else None
+    sv = ServingConfig(
+        block_size=args.serve_block_size,
+        max_batch=args.batch,
+        prefill_chunk=min(128, args.seq_len // 2),
+        decode_chunk=args.serve_chunk,
+        spec_k=args.spec_k,
+        double_buffer=not args.no_double_buffer,
+        token_budget=args.serve_token_budget,
+        kv_dtype=kv_dtype,
+    )
+    if args.serve_pool_mib is not None:
+        per_block = sv.block_bytes(cfg, args.dtype)["total_bytes"]
+        budget_blocks = int(args.serve_pool_mib * 2**20) // per_block
+        # never exceed full coverage (extra blocks would just idle), never
+        # go below the 2-block allocator minimum
+        full = sv.num_pool_blocks(min(args.seq_len, cfg.block_size))
+        sv.max_blocks = max(2, min(budget_blocks, full))
+    return sv
+
+
 def run_preflight(args, cfg, mode):
     """Static plan audit (mdi-audit) before any engine is built.
 
@@ -216,17 +271,7 @@ def run_preflight(args, cfg, mode):
     seq_len = min(args.seq_len, cfg.block_size)
     serving, kv_len = None, None
     if mode == "serve":
-        from mdi_llm_tpu.config import ServingConfig
-
-        serving = ServingConfig(
-            block_size=args.serve_block_size,
-            max_batch=args.batch,
-            prefill_chunk=min(128, args.seq_len // 2),
-            decode_chunk=args.serve_chunk,
-            spec_k=args.spec_k,
-            double_buffer=not args.no_double_buffer,
-            token_budget=args.serve_token_budget,
-        )
+        serving = _serve_config(args, cfg)
         # the widest live token axis of a serving dispatch is the unified
         # mixed step's static packed width (prompt lengths can't perturb it)
         act_t = serving.resolved_token_budget()
@@ -500,7 +545,11 @@ def run_serve(args):
 
     dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
              "float32": jnp.float32}[args.dtype]
-    kv_dtype = resolve_kv_dtype(args.kv_dtype) or dtype
+    # int8 selects the QUANTIZED POOL (ServingConfig.kv_dtype via
+    # _serve_config); the cache/compute dtype stays --dtype.  Float names
+    # keep the dense cast-on-write route
+    pool_int8 = args.kv_dtype == "int8"
+    kv_dtype = dtype if pool_int8 else (resolve_kv_dtype(args.kv_dtype) or dtype)
     cfg = Config.from_name(args.model)
     if args.pipeline:
         raise SystemExit("--mode serve runs the tp-mesh engine; drop --pipeline")
@@ -523,18 +572,11 @@ def run_serve(args):
         mesh=mesh, scan_unroll=args.scan_unroll,
     )
     n_requests = args.serve_requests or 4 * args.batch
+    serving_cfg = _serve_config(args, cfg)  # the audited config IS the
+    # engine config (incl. kv_dtype + the --serve-pool-mib block cap)
 
-    def build_engine(obs=None):
-        return gen.serve(
-            block_size=args.serve_block_size,
-            max_batch=args.batch,
-            prefill_chunk=min(128, args.seq_len // 2),
-            decode_chunk=args.serve_chunk,
-            spec_k=args.spec_k,
-            double_buffer=not args.no_double_buffer,
-            token_budget=args.serve_token_budget,
-            obs=obs,
-        )
+    def build_engine(obs=None, serving=None):
+        return gen.serve(serving=serving or serving_cfg, obs=obs)
 
     trace = synthetic_trace(
         n_requests, cfg.vocab_size, args.seq_len, args.new_tokens
@@ -550,6 +592,46 @@ def run_serve(args):
             rid, prompt, min(new, max(2, 2 * args.serve_chunk))
         )
     warm.run()
+
+    # int8 rung: also run the FP engine on the SAME trace at the SAME pool
+    # byte budget (its max_blocks shrink to what the bytes buy at fp width)
+    # so the row itself carries the capacity comparison — tokens/s, peak
+    # resident sequences, preemptions, latency percentiles, and the greedy
+    # token-match rate of the quantized streams against the fp ones.  It
+    # runs (and compiles) BEFORE the warm mark so the timed int8 region
+    # below still reports zero post-warmup recompiles
+    from mdi_llm_tpu.obs import ServingObserver
+
+    fp_results, fp_ref = None, None
+    if pool_int8:
+        sv_fp = _serve_config(args, cfg, kv_dtype=None)
+        fp_warm = build_engine(serving=sv_fp)
+        for rid, prompt, new in trace:
+            fp_warm.add_request(
+                rid, prompt, min(new, max(2, 2 * args.serve_chunk))
+            )
+        fp_warm.run()
+        fp_obs = ServingObserver()
+        fp_engine = build_engine(obs=fp_obs, serving=sv_fp)
+        for rid, prompt, new in trace:
+            fp_engine.add_request(rid, prompt, new)
+        t0 = time.perf_counter()
+        fp_results, fp_stats = fp_engine.run()
+        fp_wall = time.perf_counter() - t0
+        fp_ref = fp_stats.to_dict()
+        fp_ref.update({
+            "tokens_per_s": round(
+                fp_stats.tokens_generated / fp_wall, 2
+            ) if fp_wall else 0.0,
+            "pool_blocks": fp_engine.pool.num_blocks,
+            "kv_dtype": fp_engine.kv_dtype_name,
+            "latency": {
+                name: {k: (round(v, 6) if isinstance(v, float) else v)
+                       for k, v in summ.items()}
+                for name, summ in fp_obs.latency_summaries().items()
+            },
+        })
+
     _mark_warm()
 
     # observe the TIMED engine only: per-request TTFT/TPOT/E2E/queue-wait
@@ -568,6 +650,21 @@ def run_serve(args):
         t0 = time.perf_counter()
         results, stats = engine.run()
         wall = time.perf_counter() - t0
+
+    if fp_ref is not None:
+        # greedy token-match rate of the quantized streams vs the fp rung
+        # (longest matching prefix per request — post-divergence tokens
+        # don't count, matching the test suite's drift metric)
+        total_tok = match_tok = 0
+        for rid, prompt, _new in trace:
+            a = fp_results.get(rid, [])[len(prompt):]
+            b = results.get(rid, [])[len(prompt):]
+            n = 0
+            while n < min(len(a), len(b)) and a[n] == b[n]:
+                n += 1
+            match_tok += n
+            total_tok += max(len(a), 1)
+        fp_ref["int8_token_match_rate"] = round(match_tok / total_tok, 4)
 
     n_chips = max(1, args.tp)
     total = stats.tokens_generated / wall if wall else 0.0
@@ -598,11 +695,15 @@ def run_serve(args):
             "double_buffer": not args.no_double_buffer,
             "scan_unroll": args.scan_unroll,
             "seq_len": args.seq_len, "new_tokens": args.new_tokens,
-            "requests": n_requests, "kv_dtype": args.kv_dtype,
+            "requests": n_requests, "kv_dtype": engine.kv_dtype_name,
+            "pool_blocks": engine.pool.num_blocks,
+            "pool_mib": args.serve_pool_mib,
             "quantize": args.quantize,
         },
         "device": str(jax.devices()[0]),
     })
+    if fp_ref is not None:
+        detail["fp_reference"] = fp_ref
     return {
         "metric": f"serving tokens/sec/chip ({args.model}, cb, "
                   f"slots={args.batch}, reqs={n_requests}{tp_tag})",
@@ -610,6 +711,151 @@ def run_serve(args):
         "unit": "tokens/s/chip",
         "vs_baseline": round(value / base, 2),
         "detail": detail,
+    }
+
+
+def run_kernel(args):
+    """Paged-attention kernel microbench (ROADMAP item 4's measurement
+    substrate): time the Pallas kernel vs the gather fallback vs dense
+    attention for the three serving dispatch shapes — decode (Tq=1),
+    ragged speculative verify (Tq=8), and the unified ragged mixed
+    prefill — at BOTH pool dtypes (fp and int8), so the in-kernel dequant
+    cost is measured, not asserted.  Kernel timings need a TPU backend
+    (the interpreter measures nothing); fallback and dense run anywhere,
+    so a CPU row still banks the dtype comparison for those paths."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mdi_llm_tpu.config import Config
+    from mdi_llm_tpu.ops.attention import multihead_attention
+    from mdi_llm_tpu.ops.paged_attention import paged_attention, paged_prefill
+
+    cfg = Config.from_name(args.model)
+    H, G, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
+    B = min(args.batch, 8)
+    BS = args.serve_block_size
+    S = min(args.seq_len, 1024)
+    S -= S % BS
+    MB = S // BS
+    NB = 1 + B * MB
+    Tq = 8  # the spec_k=7 verify width
+    Tpk = 2 * B  # packed mixed step: B decode lanes + one B-token chunk
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(0)
+    dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+             "float32": jnp.float32}[args.dtype]
+
+    kf = rng.standard_normal((NB, BS, G, hs)).astype(np.float32)
+    vf = rng.standard_normal((NB, BS, G, hs)).astype(np.float32)
+    pool_fp = (jnp.asarray(kf, dtype), jnp.asarray(vf, dtype))
+
+    def quantize(arr):  # per-block-per-group symmetric int8, the pool layout
+        scale = np.abs(arr).max(axis=(1, 3)) / 127.0  # (NB, G)
+        safe = np.where(scale > 0, scale, 1.0)
+        q = np.clip(np.round(arr / safe[:, None, :, None]), -127, 127)
+        return {"q": jnp.asarray(q, jnp.int8),
+                "scale": jnp.asarray(scale, jnp.float32)}
+
+    pool_q8 = (quantize(kf), quantize(vf))
+    tables = jnp.asarray(
+        np.arange(1, NB).reshape(B, MB), jnp.int32
+    )
+    k_dense = jnp.asarray(
+        kf.reshape(NB, BS, G, hs)[np.asarray(tables).reshape(-1)]
+        .reshape(B, S, G, hs).transpose(0, 2, 1, 3), dtype
+    )
+    v_dense = jnp.asarray(
+        vf.reshape(NB, BS, G, hs)[np.asarray(tables).reshape(-1)]
+        .reshape(B, S, G, hs).transpose(0, 2, 1, 3), dtype
+    )
+
+    def timed(fn, *xs, reps=20):
+        out = fn(*xs)  # compile + warm
+        jax.block_until_ready(out)
+        jax.block_until_ready(fn(*xs))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*xs)
+        jax.block_until_ready(out)
+        return round((time.perf_counter() - t0) / reps * 1e6, 1)  # µs
+
+    q1 = jnp.asarray(rng.standard_normal((B, H, 1, hs)), dtype)
+    pos1 = jnp.full((B, 1), S - 1, jnp.int32)
+    qr = jnp.asarray(rng.standard_normal((B, H, Tq, hs)), dtype)
+    posr = jnp.asarray(
+        np.broadcast_to(np.arange(S - Tq, S), (B, Tq)).copy(), jnp.int32
+    )
+    qp = jnp.asarray(rng.standard_normal((1, H, Tpk, hs)), dtype)
+    q_slot = jnp.asarray(np.repeat(np.arange(B), 2), jnp.int32)
+    q_start = jnp.asarray(np.arange(B) * 2, jnp.int32)
+    q_len = jnp.full((B,), 2, jnp.int32)
+    posp = jnp.asarray(np.tile([S - 2, S - 1], B), jnp.int32)
+
+    def attn(pools, use_kernel):
+        k_pool, v_pool = pools
+        return {
+            "decode": lambda: timed(jax.jit(partial(
+                paged_attention, use_kernel=use_kernel,
+            )), q1, k_pool, v_pool, tables, pos1),
+            "ragged": lambda: timed(jax.jit(partial(
+                paged_attention, use_kernel=use_kernel,
+            )), qr, k_pool, v_pool, tables, posr),
+            "prefill": lambda: timed(
+                jax.jit(lambda q, kp, vp, t: paged_prefill(
+                    q, kp, vp, t, q_slot, q_start, q_len, posp,
+                    use_kernel=use_kernel,
+                )), qp, k_pool, v_pool, tables,
+            ),
+        }
+
+    dense_fns = {
+        "decode": lambda: timed(
+            jax.jit(multihead_attention), q1, k_dense, v_dense, pos1
+        ),
+        "ragged": lambda: timed(
+            jax.jit(multihead_attention), qr, k_dense, v_dense, posr
+        ),
+        # dense comparison for the mixed step: the same (head, token)
+        # rows as B lanes of 2 queries over the full contiguous window
+        "prefill": lambda: timed(
+            jax.jit(multihead_attention),
+            qp.reshape(1, H, B, 2, hs)[0].transpose(1, 0, 2, 3),
+            k_dense, v_dense,
+            posp.reshape(B, 2),
+        ),
+    }
+
+    grid = {}
+    for tag, pools in (("fp", pool_fp), ("int8", pool_q8)):
+        for op in ("decode", "ragged", "prefill"):
+            row = {
+                "fallback_us": attn(pools, False)[op](),
+                "dense_us": dense_fns[op]() if tag == "fp" else None,
+                "kernel_us": attn(pools, True)[op]() if on_tpu else None,
+            }
+            grid[f"{op}-{tag}"] = row
+    _mark_warm()
+
+    value = grid["decode-fp"]["kernel_us"] or grid["decode-fp"]["fallback_us"]
+    return {
+        "metric": (
+            f"paged-attention decode µs/dispatch ({args.model}, B={B}, "
+            f"S={S}, {'kernel' if on_tpu else 'fallback'})"
+        ),
+        "value": value,
+        "unit": "us",
+        "vs_baseline": 1.0,
+        "detail": {
+            "grid": grid,
+            "shapes": {
+                "batch": B, "seq": S, "block_size": BS, "heads": H,
+                "groups": G, "head_size": hs, "ragged_tq": Tq,
+                "packed_tokens": Tpk, "dtype": args.dtype,
+            },
+            "kernel_backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+        },
     }
 
 
@@ -775,6 +1021,8 @@ def run_direct(args):
                 out = run_train(args)
             elif args.mode == "serve":
                 out = run_serve(args)
+            elif args.mode == "kernel":
+                out = run_kernel(args)
             else:
                 out = run_decode(args)
         out.setdefault("detail", {})["compiles"] = _GUARD.summary()
@@ -851,6 +1099,32 @@ SUITE_ROWS = [
                    "--seq-len", "512", "--new-tokens", "128"],
         "ladder": [["--tp", "2"], ["--tp", "1"]],
         "timeout": 1200,
+    },
+    {  # the quantized-pool rung: the SAME cb trace with the paged pool
+        # stored int8 (per-block scales, in-kernel dequant) at a FIXED
+        # pool byte budget — the row itself also runs the fp engine at
+        # that byte budget and records the capacity comparison in
+        # detail.fp_reference (pool_blocks ~2x, resident_peak,
+        # preemptions, TTFT/TPOT percentiles, int8_token_match_rate).
+        # The ladder drops the budget cap, then falls back to the fp pool
+        # so an int8-path failure still records a serving row
+        "name": "serving-cb-int8",
+        "flags": ["--mode", "serve", "--batch", "8", "--seq-len", "512",
+                   "--new-tokens", "128", "--kv-dtype", "int8",
+                   "--serve-pool-mib", "24"],
+        "ladder": [["--serve-pool-mib", "48"], ["--kv-dtype", "auto"]],
+        "timeout": 900,
+    },
+    {  # paged-attention kernel microbench (ROADMAP item 4's measurement
+        # substrate): Pallas kernel vs gather fallback vs dense attention
+        # for decode/ragged-verify/ragged-prefill at fp AND int8 — the
+        # in-kernel dequant cost lands in detail.grid as data, not as an
+        # assertion.  Kernel timings need the TPU backend; a CPU fallback
+        # run still banks the fallback/dense dtype comparison
+        "name": "kernel-paged",
+        "flags": ["--mode", "kernel", "--batch", "8", "--seq-len", "1024"],
+        "ladder": [["--batch", "4", "--seq-len", "512"]],
+        "timeout": 900,
     },
     {  # flash-VJP training on hardware: --train-flash on forces the Pallas
         # custom_vjp (fails loudly if it cannot engage, e.g. a backend whose

@@ -405,7 +405,12 @@ def _check_memory(
     cfg, mesh = plan.cfg, plan.mesh
     sizes = mesh.sizes
     par_item = dtype_bytes(plan.dtype)
-    kv_item = dtype_bytes(plan.kv_dtype)
+    try:
+        kv_item = dtype_bytes(plan.kv_dtype)
+    except ValueError:
+        if plan.serving is None:
+            raise  # dense plans have no checker reporting dtype problems
+        kv_item = 0  # already a bad-serving-config finding; budget KV as 0
     storage = abstract_params(cfg, plan.dtype, plan.quantize)
     try:
         specs = adapt_specs_to_tree(
@@ -466,15 +471,20 @@ def _check_memory(
         )
         if plan.serving is not None:
             # an invalid pool geometry is already a bad-serving-config
-            # finding; budget it as zero instead of dividing by block_size.
-            # Per DEVICE: the pool's KV-group axis shards over tp
-            # (paged_kv_spec), so each chip holds exactly 1/tp of the pool
-            kv_dev = max(0, (
-                plan.serving.pool_bytes_per_device(
-                    cfg, _serving_tp(plan), plan.seq_len, plan.kv_dtype
-                )
-                if plan.serving.block_size >= 1 else 0
-            ))
+            # finding; budget it as zero instead of dividing by block_size
+            # (an unknown kv_dtype likewise — the serving checker reported
+            # it).  Per DEVICE: the pool's KV-group axis shards over tp
+            # (paged_kv_spec, int8 scale arrays included), so each chip
+            # holds exactly 1/tp of the pool
+            try:
+                kv_dev = max(0, (
+                    plan.serving.pool_bytes_per_device(
+                        cfg, _serving_tp(plan), plan.seq_len, plan.kv_dtype
+                    )
+                    if plan.serving.block_size >= 1 else 0
+                ))
+            except ValueError:
+                kv_dev = 0
         else:
             kv_dev = cfg.estimate_kv_bytes(plan.batch, plan.cache_len, plan.kv_dtype)
         act_batch = plan.batch
@@ -512,12 +522,20 @@ def _check_memory(
     avail = budget - params_dev - act_dev
     fits: Dict[str, Any] = {}
     if plan.serving is not None:
-        # per-device block cost under the tp-sharded pool layout: the HBM
-        # budget is per chip, so blocks-that-fit scales with the tp degree
-        per_block = cfg.estimate_kv_bytes(
-            1, plan.serving.block_size, plan.kv_dtype
-        ) // _serving_tp(plan)
+        # per-device block cost under the tp-sharded pool layout (the
+        # itemized ServingConfig.block_bytes — payload AND int8 scale side
+        # arrays, the same formula pool_bytes uses, so the fit and the
+        # estimate can never disagree): the HBM budget is per chip, so
+        # blocks-that-fit scales with the tp degree
+        try:
+            per_block = plan.serving.block_bytes(
+                cfg, plan.kv_dtype, tp=_serving_tp(plan)
+            )["total_bytes"]
+        except ValueError:
+            per_block = 0  # unknown kv_dtype: bad-serving-config reported
         fits["max_pool_blocks"] = max(0, int(avail // per_block)) if per_block else 0
+        if "kv_pool" in breakdown:
+            breakdown["kv_pool"]["blocks_at_budget"] = fits["max_pool_blocks"]
     else:
         if plan.is_pipeline:
             per_lane = kv_dev // max(1, plan.samples_per_slot)
@@ -761,16 +779,35 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             ))
     if sv.block_size >= 1:
         tp = _serving_tp(plan)
+        # itemized per-block cost (config.ServingConfig.block_bytes): the
+        # ONE formula pool construction, this breakdown and the --hbm-gb
+        # fit share.  Unknown kv_dtype names refuse here (dtype_bytes) —
+        # the same wall the engine raises at construction
+        try:
+            bb = sv.block_bytes(plan.cfg, plan.kv_dtype)
+        except ValueError as e:
+            findings.append(_finding(
+                plan, "bad-serving-config",
+                f"kv_dtype {sv.resolved_kv_dtype(plan.kv_dtype)!r}: {e}",
+            ))
+            return
         breakdown["kv_pool"] = {
             "num_blocks": n_blocks,
             "block_size": sv.block_size,
+            "kv_dtype": bb["kv_dtype"],
             "pool_bytes": sv.pool_bytes(plan.cfg, plan.seq_len, plan.kv_dtype),
+            # the int8 side arrays (per-block-per-group f32 scales), 0 at
+            # any fp dtype — pool_bytes already includes them
+            "scale_bytes": n_blocks * bb["scale_bytes"],
             # per-device slice of the tp-sharded pool (== pool_bytes / tp,
             # exactly: the KV-group axis divides or bad-serving-mesh fires)
             "pool_bytes_per_device": sv.pool_bytes_per_device(
                 plan.cfg, tp, plan.seq_len, plan.kv_dtype
             ),
             "tp": tp,
+            # blocks the --hbm-gb budget admits after params+activations;
+            # filled in by the memory checker when a budget is given
+            "blocks_at_budget": None,
             "decode_chunk": sv.decode_chunk,
             "spec_k": sv.spec_k,
             "reserve_headroom_blocks": headroom,
@@ -931,7 +968,12 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=("bfloat16", "float16", "float32"))
     run.add_argument("--quantize", default="none",
                      choices=("none", "int8", "w8a8", "int4"))
-    run.add_argument("--kv-dtype", default="auto")
+    run.add_argument("--kv-dtype", default="auto",
+                     help="KV-cache / paged-pool storage dtype; with "
+                     "--serve, 'int8' audits the quantized pool (int8 "
+                     "payload + per-block-per-group f32 scales, "
+                     "~2x blocks per --hbm-gb); unknown names are refused "
+                     "(bad-serving-config)")
     srv = ap.add_argument_group("serving (paged KV pool)")
     srv.add_argument("--serve", action="store_true",
                      help="audit a ServingConfig pool instead of a dense cache")
@@ -1009,6 +1051,10 @@ def _plan_from_args(args) -> PlanSpec:
             max_batch=args.max_batch,
             prefill_chunk=args.prefill_chunk,
             token_budget=args.token_budget,
+            # the pool dtype rides --kv-dtype (e.g. int8 for the quantized
+            # pool: payload + scale bytes both audited); unknown names
+            # surface as bad-serving-config, exactly like the engine
+            kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
         )
     return PlanSpec(
         cfg=cfg,

@@ -32,7 +32,15 @@ KV_DTYPES = {**DTYPES, "float8": jnp.float8_e4m3fn}
 
 
 def resolve_kv_dtype(name: str):
-    """Map --kv-dtype to a jnp dtype; "auto" → None (follow the weights)."""
+    """Map --kv-dtype to a jnp dtype; "auto" → None (follow the weights).
+    "int8" is NOT a dense-cache dtype — it selects the quantized paged
+    pool via ServingConfig.kv_dtype, and serving entry points route it
+    there before calling this."""
+    if name == "int8":
+        raise ValueError(
+            "--kv-dtype int8 quantizes the paged serving pool "
+            "(ServingConfig.kv_dtype), not the dense KV cache"
+        )
     return None if name == "auto" else KV_DTYPES[name]
 
 
@@ -81,7 +89,11 @@ def make_ep_mesh(ep_devices: int, cfg: Config):
     return make_mesh({"ep": ep_devices}, jax.devices()[:ep_devices])
 
 
-def add_common_args(ap: argparse.ArgumentParser) -> None:
+def add_common_args(ap: argparse.ArgumentParser, serving_kv: bool = False) -> None:
+    """`serving_kv=True` (mdi-serve) additionally accepts --kv-dtype int8:
+    the paged pool stores int8 blocks with per-block-per-group scales
+    (ServingConfig.kv_dtype) — a serving-engine feature, so the dense-cache
+    entry points keep refusing it at the parser."""
     ap.add_argument("--ckpt", type=Path, default=None, help="checkpoint directory")
     ap.add_argument(
         "--model", default=None, help="registry model name (random init if no --ckpt)"
@@ -105,10 +117,17 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
     )
     ap.add_argument(
         "--kv-dtype",
-        choices=("auto", *KV_DTYPES),
+        choices=("auto", *KV_DTYPES) + (("int8",) if serving_kv else ()),
         default="auto",
         help="KV-cache storage dtype (float8 halves cache HBM traffic; "
-        "reads upcast to the compute dtype)",
+        "reads upcast to the compute dtype)"
+        + (
+            "; int8 quantizes the paged pool — int8 blocks with "
+            "per-block-per-head scales dequantized inside the attention "
+            "kernels, ~2x resident sequences per HBM byte "
+            "(docs/perf.md 'Quantized paged KV')"
+            if serving_kv else ""
+        ),
     )
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--debug", action="store_true")

@@ -44,7 +44,9 @@ from mdi_llm_tpu.cli._common import (
 
 def build_parser():
     ap = argparse.ArgumentParser(description=__doc__)
-    add_common_args(ap)
+    # serving_kv: --kv-dtype additionally accepts int8 (quantized paged
+    # pool — int8 blocks + per-block scales, docs/perf.md)
+    add_common_args(ap, serving_kv=True)
     ap.add_argument("--n-tokens", type=int, default=128,
                     help="max new tokens per request")
     ap.add_argument("--prompt", default="Once upon a time,",
@@ -168,6 +170,10 @@ def main(argv=None):
     from mdi_llm_tpu.cli._common import resolve_config
     from mdi_llm_tpu.config import ServingConfig
 
+    # --kv-dtype int8 selects the QUANTIZED POOL (ServingConfig.kv_dtype:
+    # int8 blocks + per-block scales, ~2x resident sequences per HBM byte);
+    # the dense-cache cast dtypes keep flowing through cache_dtype below
+    pool_int8 = args.kv_dtype == "int8"
     serving_cfg = ServingConfig(
         block_size=args.block_size,
         max_blocks=args.max_blocks,
@@ -179,6 +185,7 @@ def main(argv=None):
         double_buffer=not args.no_double_buffer,
         prefix_caching=not args.no_prefix_cache,
         temperature=args.temperature,
+        kv_dtype="int8" if pool_int8 else None,
     )
     report = preflight(
         resolve_config(args),
@@ -199,10 +206,14 @@ def main(argv=None):
             f" ({pool['pool_bytes_per_device'] / 2**20:.1f} MiB/device over "
             f"tp={pool['tp']})" if pool.get("tp", 1) > 1 else ""
         )
+        q_tag = (
+            f" [int8 + {pool['scale_bytes'] / 2**20:.2f} MiB scales]"
+            if pool.get("kv_dtype") == "int8" else ""
+        )
         print(
             f"mdi-serve: KV pool {pool['num_blocks']} blocks x "
             f"{pool['block_size']} tokens ~= {pool['pool_bytes'] / 2**20:.1f}"
-            f" MiB{per_dev}",
+            f" MiB{q_tag}{per_dev}",
             file=sys.stderr,
         )
 
@@ -218,7 +229,10 @@ def main(argv=None):
     gen = Generator(
         cfg, params,
         max_seq_length=args.sequence_length,
-        cache_dtype=resolve_kv_dtype(args.kv_dtype) or dtype,
+        cache_dtype=(
+            dtype if pool_int8
+            else resolve_kv_dtype(args.kv_dtype) or dtype
+        ),
         quantize=args.quantize,
         mesh=mesh,
         scan_unroll=args.scan_unroll,
@@ -271,6 +285,7 @@ def main(argv=None):
     # rows embed) + CLI topology extras + the latency percentile block
     line = stats.to_dict()
     line.update({
+        "kv_dtype": engine.kv_dtype_name,
         "tp": args.tp,
         "devices": args.tp,
         "tokens_per_s_per_chip": round(stats.tokens_per_s / max(1, args.tp), 2),

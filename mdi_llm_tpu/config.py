@@ -476,6 +476,14 @@ class ServingConfig:
     # attention backend: None → auto (Pallas kernel on TPU decode steps,
     # exact lax gather fallback elsewhere — tier-1 CPU tests use the latter)
     use_kernel: Optional[bool] = None
+    # paged-pool storage dtype.  None → follow the engine's cache dtype
+    # (the fp path, byte- and bit-identical to before this knob existed).
+    # "int8" stores blocks as int8 with per-block-per-KV-group f32 scales
+    # (quantize-on-scatter, dequantize inside the kernels' block loop —
+    # ops/paged_attention.py), roughly doubling the blocks a fixed HBM
+    # budget holds; other float names cast on write like the dense cache's
+    # --kv-dtype.  Unknown names are refused via `dtype_bytes`.
+    kv_dtype: Optional[str] = None
 
     def resolved_token_budget(self) -> int:
         """The unified serving step's per-dispatch token-axis width: every
@@ -511,15 +519,60 @@ class ServingConfig:
             ahead += max(1, self.decode_chunk)
         return -(-ahead // self.block_size) + 1
 
+    def resolved_kv_dtype(self, default="bfloat16") -> str:
+        """The pool's storage dtype NAME: `kv_dtype` when set, else the
+        caller's `default` (the engine passes its cache dtype; audit passes
+        the plan's).  Normalized to a string so byte accounting and the
+        int8 branch key on one spelling."""
+        dt = self.kv_dtype if self.kv_dtype is not None else default
+        if isinstance(dt, str):
+            return dt
+        name = getattr(dt, "__name__", None) or getattr(dt, "name", None)
+        return name or str(dt)
+
+    def block_bytes(
+        self, cfg: "Config", dtype="bfloat16", tp: int = 1
+    ) -> Dict[str, Any]:
+        """Itemized HBM bytes of ONE pool block, k + v across all layers —
+        THE per-block cost model shared by `pool_bytes`, the mdi-audit
+        breakdown and the `--hbm-gb` blocks-that-fit computation, so the
+        three can never disagree (the pre-refactor `pool_bytes` pushed a
+        bare dtype through `estimate_kv_bytes` with no room for the int8
+        scale side arrays).
+
+        Returns {"kv_dtype", "kv_bytes", "scale_bytes", "total_bytes"};
+        int8 pools add the per-block-per-KV-group f32 scales
+        (`ops/paged_attention.py` layout), every other dtype has
+        scale_bytes 0.  `tp > 1` gives the PER-DEVICE slice (the KV-group
+        axis shards when divisible — `paged_kv_spec` — scales included).
+        Unknown dtype names raise via `dtype_bytes` (the refusal contract
+        for `kv_dtype` values the byte table doesn't know)."""
+        name = self.resolved_kv_dtype(dtype)
+        item = dtype_bytes(name)  # raises on unknown names
+        G = cfg.n_query_groups
+        if tp > 1 and G % tp == 0:
+            G //= int(tp)
+        kv = 2 * cfg.n_layer * self.block_size * G * cfg.head_size * item
+        scale = 2 * cfg.n_layer * G * 4 if name == "int8" else 0
+        return {
+            "kv_dtype": name,
+            "kv_bytes": int(kv),
+            "scale_bytes": int(scale),
+            "total_bytes": int(kv + scale),
+        }
+
     def pool_bytes(
         self, cfg: "Config", max_seq_length: Optional[int] = None, dtype="bfloat16"
     ) -> int:
-        """HBM bytes of the paged KV pool for model `cfg`: k + v, each
-        (L, num_blocks, block_size, G, hs) — `transformer.init_paged_kv_cache`.
+        """HBM bytes of the paged KV pool for model `cfg`: num_pool_blocks ×
+        the itemized `block_bytes` (k + v payload at the pool dtype, plus
+        the int8 scale arrays) — byte-exact against
+        `transformer.init_paged_kv_cache`'s live arrays at either dtype.
+        `self.kv_dtype` wins over the `dtype` argument when set.
         Used by the mdi-audit memory checker and the bench/serve logs."""
         max_seq = int(min(max_seq_length or cfg.block_size, cfg.block_size))
         n_blocks = self.num_pool_blocks(max_seq)
-        return cfg.estimate_kv_bytes(1, n_blocks * self.block_size, dtype)
+        return n_blocks * self.block_bytes(cfg, dtype)["total_bytes"]
 
     def pool_bytes_per_device(
         self,
@@ -529,16 +582,16 @@ class ServingConfig:
         dtype="bfloat16",
     ) -> int:
         """Per-device HBM bytes of the pool under a tp serving mesh: the
-        KV-group axis shards over tp (`parallel.sharding.paged_kv_spec`), so
-        each chip holds exactly 1/tp of every block's bytes.  Byte-exact
-        against the live sharded engine because G % tp == 0 is a serving
-        precondition (`validate_tp_divisibility`; mdi-audit errors with
+        KV-group axis shards over tp (`parallel.sharding.paged_kv_spec`,
+        int8 scale arrays included), so each chip holds exactly 1/tp of
+        every block's bytes.  Byte-exact against the live sharded engine
+        because G % tp == 0 is a serving precondition
+        (`validate_tp_divisibility`; mdi-audit errors with
         `bad-serving-mesh` otherwise and this falls back to the whole pool,
         mirroring the runtime's drop-indivisible-sharding rule)."""
-        total = self.pool_bytes(cfg, max_seq_length, dtype)
-        if tp > 1 and cfg.n_query_groups % tp == 0:
-            return total // int(tp)
-        return total
+        max_seq = int(min(max_seq_length or cfg.block_size, cfg.block_size))
+        n_blocks = self.num_pool_blocks(max_seq)
+        return n_blocks * self.block_bytes(cfg, dtype, tp=tp)["total_bytes"]
 
 
 def _yaml_scalar(v: Any) -> str:

@@ -301,6 +301,7 @@ class Generator:
         self.mesh = mesh
         self._kv_sharding = None
         self._paged_kv_sharding = None
+        self._paged_kv_scale_sharding = None
         self._dp = 1
         self._moe_impl = None
         if quantize not in (None, "none") and quantize not in FLAG_TO_MODE:
@@ -375,11 +376,18 @@ class Generator:
                 ),
             )
             # serving engine's paged pool (L, NB, BS, G, hs): KV groups on
-            # tp, every block resident on every device's head-slice
-            from mdi_llm_tpu.parallel.sharding import paged_kv_spec
+            # tp, every block resident on every device's head-slice.  The
+            # int8 pool's (L, NB, G) scale arrays shard the same group axis
+            from mdi_llm_tpu.parallel.sharding import (
+                paged_kv_scale_spec,
+                paged_kv_spec,
+            )
 
             self._paged_kv_sharding = NamedSharding(
                 mesh, paged_kv_spec("tp" if tp_n > 1 else None)
+            )
+            self._paged_kv_scale_sharding = NamedSharding(
+                mesh, paged_kv_scale_spec("tp" if tp_n > 1 else None)
             )
         self.params = params
         if cache_dtype is None:
@@ -414,10 +422,19 @@ class Generator:
     def _place_paged_kv(self, kv):
         """Lay the serving engine's pooled block cache over the mesh: KV
         groups sharded on tp (`parallel.sharding.paged_kv_spec`), block and
-        token axes resident everywhere.  No-op without a mesh."""
+        token axes resident everywhere.  The int8 pool's 3-D scale leaves
+        take the matching group-sharded `paged_kv_scale_spec` layout.
+        No-op without a mesh."""
         if self._paged_kv_sharding is None:
             return kv
-        return jax.device_put(kv, self._paged_kv_sharding)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x,
+                self._paged_kv_sharding if x.ndim == 5
+                else self._paged_kv_scale_sharding,
+            ),
+            kv,
+        )
 
     # -- compiled phases -----------------------------------------------------
 

@@ -709,9 +709,25 @@ def init_paged_kv_cache(
     """Pooled block cache for the serving engine: k/v of shape
     (L, num_blocks, block_size, G, hs).  Block 0 is reserved by the
     allocator (`serving.kv_pool.KVPool`) as the write-only trash block for
-    padding lanes/positions."""
+    padding lanes/positions.
+
+    `dtype="int8"` builds the QUANTIZED pool: k/v each become
+    `{"q": int8 (L, num_blocks, block_size, G, hs), "scale": f32
+    (L, num_blocks, G)}` — symmetric per-block-per-KV-group scales,
+    quantized on scatter and dequantized inside the attention kernels'
+    block loop (`ops/paged_attention.py`).  The layer scan, donation and
+    sharding all thread the scale leaves automatically (they ride the same
+    (L, NB, ...) leading axes as the payload)."""
     L = cfg.n_layer if n_layer is None else n_layer
     shape = (L, num_blocks, block_size, cfg.n_query_groups, cfg.head_size)
+    if dtype in ("int8", jnp.int8) or getattr(dtype, "name", None) == "int8":
+        sshape = (L, num_blocks, cfg.n_query_groups)
+        return {
+            "k": {"q": jnp.zeros(shape, jnp.int8),
+                  "scale": jnp.zeros(sshape, jnp.float32)},
+            "v": {"q": jnp.zeros(shape, jnp.int8),
+                  "scale": jnp.zeros(sshape, jnp.float32)},
+        }
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 

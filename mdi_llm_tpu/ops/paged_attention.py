@@ -41,6 +41,25 @@ Writes go through `paged_update`: a scatter of the chunk's K/V into
 `(block, offset)` slots resolved through the table.  Positions past the
 table's coverage (prefill bucket padding) are redirected to block 0, which
 the serving pool reserves as a write-only trash block.
+
+**Quantized pools** (`ServingConfig(kv_dtype="int8")`): each of k/v is a
+dict `{"q": int8 (num_blocks, block_size, G, hs), "scale": f32
+(num_blocks, G)}` — symmetric per-BLOCK-per-KV-group scales, so the side
+array costs 4 bytes per (block, group) against block_size*hs int8 payload
+bytes (the ~2x capacity win stays real even at small head sizes, where
+per-token scales would eat it).  `paged_update` quantizes on scatter with
+a monotone scale: the block's scale only ever grows (`.at[].max` over the
+written tokens' max-abs/127), and when it grows the block's existing int8
+payload is requantized in the same update (gather the written blocks,
+rescale by old/new, scatter back — a transient of written blocks only,
+never the pool).  Consequences the serving engine relies on, pinned by
+tests: a frozen-lane rewrite of the same (token, position) leaves scale
+and payload bytes bit-identical, and a block's final scale is independent
+of how its tokens were grouped into update calls.  All three kernels
+dequantize INSIDE their KV-block loop (`k = int8_block * scale[group]` in
+f32, fused after the block DMA) — no gathered-fp pool transient — and the
+lax fallbacks run the same dequant-to-f32 math so kernel==fallback parity
+holds at int8 exactly like fp.
 """
 
 from __future__ import annotations
@@ -62,40 +81,103 @@ __all__ = [
 ]
 
 
+def _pool_parts(pool):
+    """(payload, scale-or-None) view of a pool: fp pools are bare arrays,
+    int8 pools are {"q": int8 blocks, "scale": f32 (num_blocks, G)}."""
+    if isinstance(pool, dict):
+        return pool["q"], pool["scale"]
+    return pool, None
+
+
+def _quantized_update(pool, new, blk, off):
+    """Quantizing scatter into one int8 pool: `new` (N, G, hs) fp values
+    land at (blk[n], off[n]) under the block's per-group scale.
+
+    The scale is a monotone running max (`.at[].max` of the written tokens'
+    max-abs/127, duplicates folded correctly), so a rewrite of the same
+    value at the same slot is byte-idempotent and the final scale is
+    independent of how tokens were grouped into update calls.  When a write
+    DOES grow a block's scale, the block's existing payload requantizes by
+    old/new in the same scatter — the transient is the written blocks only
+    (N × block_size × G × hs int8), never a pool-wide or gathered-fp copy.
+    """
+    q, s = pool["q"], pool["scale"]
+    vals = new.astype(jnp.float32)
+    tok_scale = jnp.max(jnp.abs(vals), axis=-1) / 127.0  # (N, G)
+    new_s = s.at[blk].max(tok_scale)
+    old_g = s[blk]  # (N, G) pre-update block scales
+    new_g = new_s[blk]  # (N, G) post-update (>= old, monotone)
+    # rescale existing payload where the scale grew; an all-zero block
+    # (scale 0) maps 0 -> 0 whatever the factor, so the guard only dodges
+    # the 0/0
+    factor = jnp.where(new_g > 0, old_g / jnp.maximum(new_g, 1e-30), 0.0)
+    requant = jnp.round(
+        q[blk].astype(jnp.float32) * factor[:, None, :, None]
+    ).astype(jnp.int8)
+    q = q.at[blk].set(requant)  # duplicate blk entries scatter identical
+    # blocks (same source block, same old/new scale), so order is moot
+    tok_q = jnp.clip(
+        jnp.round(vals / jnp.maximum(new_g, 1e-30)[..., None]), -127, 127
+    ).astype(jnp.int8)
+    q = q.at[blk, off].set(tok_q)
+    return {"q": q, "scale": new_s}
+
+
 def paged_update(
-    k_pool: jnp.ndarray,  # (num_blocks, block_size, G, hs)
-    v_pool: jnp.ndarray,
+    k_pool,  # (num_blocks, block_size, G, hs), or int8 {"q", "scale"}
+    v_pool,
     k_new: jnp.ndarray,  # (B, T, G, hs)
     v_new: jnp.ndarray,
     block_tables: jnp.ndarray,  # (B, max_blocks) int32
     pos: jnp.ndarray,  # (B, T) absolute positions of the chunk's tokens
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+):
     """Scatter a chunk's K/V into the pool through the block tables.
 
     Slot for position p: block `table[p // block_size]`, offset
     `p % block_size`.  Positions whose block index falls outside the table
     (bucket padding past the sequence budget) write to block 0 — the pool's
     reserved trash block — so padding can never corrupt a live block.
+
+    int8 pools quantize on scatter (`_quantized_update`): per-block
+    per-group scales grow monotonically and the written blocks requantize
+    in place when they do.
     """
     MB = block_tables.shape[1]
-    BS = k_pool.shape[1]
+    BS = _pool_parts(k_pool)[0].shape[1]
     idx = pos // BS
     blk = jnp.take_along_axis(block_tables, jnp.clip(idx, 0, MB - 1), axis=1)
     blk = jnp.where(idx < MB, blk, 0)
     off = pos % BS
+    if isinstance(k_pool, dict):
+        blk_f, off_f = blk.reshape(-1), off.reshape(-1)
+        G, hs = k_new.shape[-2:]
+        k_pool = _quantized_update(
+            k_pool, k_new.reshape(-1, G, hs), blk_f, off_f
+        )
+        v_pool = _quantized_update(
+            v_pool, v_new.reshape(-1, G, hs), blk_f, off_f
+        )
+        return k_pool, v_pool
     k_pool = k_pool.at[blk, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[blk, off].set(v_new.astype(v_pool.dtype))
     return k_pool, v_pool
 
 
 def gather_paged_kv(
-    pool: jnp.ndarray,  # (num_blocks, block_size, G, hs)
+    pool,  # (num_blocks, block_size, G, hs), or int8 {"q", "scale"}
     block_tables: jnp.ndarray,  # (B, max_blocks)
 ) -> jnp.ndarray:
     """Materialize each sequence's contiguous (B, G, S, hs) view,
     S = max_blocks * block_size.  Flattened slot j holds absolute position
-    j by the table-layout contract."""
-    g = pool[block_tables]  # (B, MB, BS, G, hs)
+    j by the table-layout contract.  int8 pools dequantize to f32 — the
+    same `int8 * scale` math the kernels run inside their block loop, so
+    the fallback stays the kernels' parity reference at int8 too."""
+    if isinstance(pool, dict):
+        g = pool["q"][block_tables].astype(jnp.float32)  # (B, MB, BS, G, hs)
+        s = pool["scale"][block_tables]  # (B, MB, G)
+        g = g * s[:, :, None, :, None]
+    else:
+        g = pool[block_tables]  # (B, MB, BS, G, hs)
     B, MB, BS, G, hs = g.shape
     return g.reshape(B, MB * BS, G, hs).transpose(0, 2, 1, 3)
 
@@ -103,6 +185,14 @@ def gather_paged_kv(
 def _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale):
     k = gather_paged_kv(k_pool, block_tables)
     v = gather_paged_kv(v_pool, block_tables)
+    if isinstance(k_pool, dict):
+        # dequantized KV is f32; run q in f32 too so the softmax chain is
+        # the exact math the kernels compute (multihead_attention would
+        # otherwise downcast the f32 KV to q's dtype at the read)
+        out = multihead_attention(
+            q.astype(jnp.float32), k, v, q_pos, scale=scale
+        )
+        return out.astype(q.dtype)
     # identical masking/softmax to the dense op: slot j valid iff j <= q_pos
     return multihead_attention(q, k, v, q_pos, scale=scale)
 
@@ -139,12 +229,20 @@ def _run_sharded_kernel(kernel_fn, mesh, axis, q, k_pool, v_pool, *scalars):
     from jax.sharding import PartitionSpec as P
 
     q_spec = P(None, axis, None, None)
-    pool_spec = P(None, None, axis, None)
+
+    def pool_spec(pool):
+        # int8 pools carry their per-block-per-group scale alongside; it
+        # shards on the same KV-group axis, so each device dequantizes its
+        # own group-slice with its own scale slice — no cross-shard reads
+        if isinstance(pool, dict):
+            return {"q": P(None, None, axis, None), "scale": P(None, axis)}
+        return P(None, None, axis, None)
+
     rep = tuple(P(*([None] * x.ndim)) for x in scalars)
     return jax.shard_map(
         kernel_fn,
         mesh=mesh,
-        in_specs=(q_spec, pool_spec, pool_spec) + rep,
+        in_specs=(q_spec, pool_spec(k_pool), pool_spec(v_pool)) + rep,
         out_specs=q_spec,
         check_vma=False,
     )(q, k_pool, v_pool, *scalars)
@@ -158,16 +256,19 @@ def _decode_kernel(
     q_ref,  # (1, n_head, hs)
     k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
     v_ref,
-    o_ref,  # (1, n_head, hs)
-    # scratch
-    m_ref,  # (n_head, 128) f32 running max (lane-broadcast scalar)
-    l_ref,  # (n_head, 128) f32 running denominator
-    acc_ref,  # (n_head, hs) f32 running numerator
-    *,
+    # quantized pools insert (ks_ref, vs_ref) — the block's (1, G) f32
+    # scales, riding the same table-resolved index map as k/v — before the
+    # output; fp pools go straight to o_ref
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     block_size: int,
     n_groups: int,
     scale: float,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -184,6 +285,11 @@ def _decode_kernel(
         q = q_ref[0].astype(jnp.float32)  # (n_head, hs)
         k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # in-loop dequant: the int8 block just DMA'd scales by its own
+            # per-group factor — no fp copy of the pool ever materializes
+            k = k * ks_ref[0][None, :, None]
+            v = v * vs_ref[0][None, :, None]
         n_head, hs = q.shape
         q_per_kv = n_head // n_groups
         qg = q.reshape(n_groups, q_per_kv, hs)
@@ -238,17 +344,20 @@ def _ragged_decode_kernel(
     q_ref,  # (1, n_head, Tq, hs)
     k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
     v_ref,
-    o_ref,  # (1, n_head, Tq, hs)
-    # scratch: every (head, query) pair is one independent softmax row
-    m_ref,  # (n_head * Tq, 128) f32 running max (lane-broadcast scalar)
-    l_ref,  # (n_head * Tq, 128) f32 running denominator
-    acc_ref,  # (n_head * Tq, hs) f32 running numerator
-    *,
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref — see
+    # _decode_kernel: quantized pools insert the block's (1, G) scales
     block_size: int,
     n_groups: int,
     n_queries: int,
     scale: float,
+    quantized: bool = False,
 ):
+    # o_ref (1, n_head, Tq, hs); scratch: every (head, query) pair is one
+    # independent softmax row — m/l (n_head * Tq, 128), acc (n_head*Tq, hs)
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -267,6 +376,9 @@ def _ragged_decode_kernel(
         q_per_kv = n_head // n_groups
         k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:  # in-loop dequant, see _decode_kernel
+            k = k * ks_ref[0][None, :, None]
+            v = v * vs_ref[0][None, :, None]
         # heads map onto their KV group; the Tq queries fold into the row
         # dim so one dot_general scores every (head, query) pair
         qg = q.reshape(n_groups, q_per_kv * Tq, hs)
@@ -321,7 +433,10 @@ def _paged_attention_ragged_kernel(
 ):
     """q: (B, n_head, Tq, hs) → (B, n_head, Tq, hs), per-slot q_pos (B, Tq)."""
     B, n_head, Tq, hs = q.shape
-    NB, BS, G, _ = k_pool.shape
+    k_arr, k_sc = _pool_parts(k_pool)
+    v_arr, v_sc = _pool_parts(v_pool)
+    quantized = k_sc is not None
+    NB, BS, G, _ = k_arr.shape
     MB = block_tables.shape[1]
     lens = (jnp.max(q_pos, axis=1) + 1).astype(jnp.int32)
     tables = block_tables.astype(jnp.int32)
@@ -331,14 +446,23 @@ def _paged_attention_ragged_kernel(
         needed = i * BS < lens_ref[bidx]
         return (jnp.where(needed, tables_ref[bidx, i], 0), 0, 0, 0)
 
+    def scale_index(bidx, i, tables_ref, lens_ref, qpos_ref):
+        needed = i * BS < lens_ref[bidx]
+        return (jnp.where(needed, tables_ref[bidx, i], 0), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, n_head, Tq, hs), lambda b, i, *_: (b, 0, 0, 0)),
+        pl.BlockSpec((1, BS, G, hs), kv_index),
+        pl.BlockSpec((1, BS, G, hs), kv_index),
+    ]
+    operands = [q, k_arr, v_arr]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, G), scale_index)] * 2
+        operands += [k_sc, v_sc]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, MB),
-        in_specs=[
-            pl.BlockSpec((1, n_head, Tq, hs), lambda b, i, *_: (b, 0, 0, 0)),
-            pl.BlockSpec((1, BS, G, hs), kv_index),
-            pl.BlockSpec((1, BS, G, hs), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_head, Tq, hs), lambda b, i, *_: (b, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((n_head * Tq, 128), jnp.float32),
@@ -349,13 +473,14 @@ def _paged_attention_ragged_kernel(
     kern = functools.partial(
         _ragged_decode_kernel,
         block_size=BS, n_groups=G, n_queries=Tq, scale=scale,
+        quantized=quantized,
     )
     out = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, n_head, Tq, hs), q.dtype),
         interpret=interpret,
-    )(tables, lens, q_pos.astype(jnp.int32), q, k_pool, v_pool)
+    )(tables, lens, q_pos.astype(jnp.int32), *operands)
     return out
 
 
@@ -370,17 +495,20 @@ def _ragged_prefill_kernel(
     q_ref,  # (1, n_head, T, hs) — the whole packed batch rides every step
     k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
     v_ref,
-    o_ref,  # (1, n_head, T, hs)
-    # scratch: every (head, packed token) pair is one online-softmax row
-    m_ref,  # (n_head * T, 128) f32 running max (lane-broadcast scalar)
-    l_ref,  # (n_head * T, 128) f32 running denominator
-    acc_ref,  # (n_head * T, hs) f32 running numerator
-    *,
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref — see
+    # _decode_kernel: quantized pools insert the block's (1, G) scales
     block_size: int,
     n_groups: int,
     n_tokens: int,
     scale: float,
+    quantized: bool = False,
 ):
+    # o_ref (1, n_head, T, hs); scratch: every (head, packed token) pair
+    # is one online-softmax row — m/l (n_head * T, 128), acc (n_head*T, hs)
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     # Known tradeoff: every grid step scores the WHOLE packed q against the
     # step's kv block and masks rows outside the current slot's span, so
     # ~(1 - 1/n_live_slots) of each matmul is discarded.  The static shapes
@@ -409,6 +537,9 @@ def _ragged_prefill_kernel(
         q_per_kv = n_head // n_groups
         k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:  # in-loop dequant, see _decode_kernel
+            k = k * ks_ref[0][None, :, None]
+            v = v * vs_ref[0][None, :, None]
         qg = q.reshape(n_groups, q_per_kv * T, hs)
         s = jax.lax.dot_general(
             qg,
@@ -478,7 +609,10 @@ def _paged_prefill_kernel(
     """q: (1, n_head, T, hs) packed slot-major → (1, n_head, T, hs)."""
     B, n_head, T, hs = q.shape
     assert B == 1, "paged_prefill packs every slot into one ragged batch"
-    NB, BS, G, _ = k_pool.shape
+    k_arr, k_sc = _pool_parts(k_pool)
+    v_arr, v_sc = _pool_parts(v_pool)
+    quantized = k_sc is not None
+    NB, BS, G, _ = k_arr.shape
     S, MB = block_tables.shape
     tables = block_tables.astype(jnp.int32)
     qstart = q_start.astype(jnp.int32)
@@ -495,14 +629,26 @@ def _paged_prefill_kernel(
         )
         return (jnp.where(needed, tables_ref[sidx, i], 0), 0, 0, 0)
 
+    def scale_index(sidx, i, tables_ref, qstart_ref, qlen_ref, qpos0_ref):
+        needed = jnp.logical_and(
+            qlen_ref[sidx] > 0,
+            i * BS < qpos0_ref[sidx] + qlen_ref[sidx],
+        )
+        return (jnp.where(needed, tables_ref[sidx, i], 0), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)),
+        pl.BlockSpec((1, BS, G, hs), kv_index),
+        pl.BlockSpec((1, BS, G, hs), kv_index),
+    ]
+    operands = [q, k_arr, v_arr]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, G), scale_index)] * 2
+        operands += [k_sc, v_sc]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(S, MB),
-        in_specs=[
-            pl.BlockSpec((1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)),
-            pl.BlockSpec((1, BS, G, hs), kv_index),
-            pl.BlockSpec((1, BS, G, hs), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)
         ),
@@ -515,13 +661,14 @@ def _paged_prefill_kernel(
     kern = functools.partial(
         _ragged_prefill_kernel,
         block_size=BS, n_groups=G, n_tokens=T, scale=scale,
+        quantized=quantized,
     )
     return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((1, n_head, T, hs), q.dtype),
         interpret=interpret,
-    )(tables, qstart, qlen, qpos0, q, k_pool, v_pool)
+    )(tables, qstart, qlen, qpos0, *operands)
 
 
 # packed tokens per gather in the lax fallback: each lane materializes its
@@ -649,7 +796,10 @@ def _paged_attention_kernel(
     """q: (B, n_head, 1, hs) → (B, n_head, 1, hs)."""
     B, n_head, Tq, hs = q.shape
     assert Tq == 1, "kernel path is decode-only (Tq == 1)"
-    NB, BS, G, _ = k_pool.shape
+    k_arr, k_sc = _pool_parts(k_pool)
+    v_arr, v_sc = _pool_parts(v_pool)
+    quantized = k_sc is not None
+    NB, BS, G, _ = k_arr.shape
     MB = block_tables.shape[1]
     lens = (q_pos[:, 0] + 1).astype(jnp.int32)
     tables = block_tables.astype(jnp.int32)
@@ -660,14 +810,23 @@ def _paged_attention_kernel(
         needed = i * BS < lens_ref[bidx]
         return (jnp.where(needed, tables_ref[bidx, i], 0), 0, 0, 0)
 
+    def scale_index(bidx, i, tables_ref, lens_ref):
+        needed = i * BS < lens_ref[bidx]
+        return (jnp.where(needed, tables_ref[bidx, i], 0), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, n_head, hs), lambda b, i, *_: (b, 0, 0)),
+        pl.BlockSpec((1, BS, G, hs), kv_index),
+        pl.BlockSpec((1, BS, G, hs), kv_index),
+    ]
+    operands = [q[:, :, 0, :], k_arr, v_arr]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, G), scale_index)] * 2
+        operands += [k_sc, v_sc]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, MB),
-        in_specs=[
-            pl.BlockSpec((1, n_head, hs), lambda b, i, *_: (b, 0, 0)),
-            pl.BlockSpec((1, BS, G, hs), kv_index),
-            pl.BlockSpec((1, BS, G, hs), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_head, hs), lambda b, i, *_: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((n_head, 128), jnp.float32),
@@ -676,14 +835,15 @@ def _paged_attention_kernel(
         ],
     )
     kern = functools.partial(
-        _decode_kernel, block_size=BS, n_groups=G, scale=scale
+        _decode_kernel, block_size=BS, n_groups=G, scale=scale,
+        quantized=quantized,
     )
     out = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, n_head, hs), q.dtype),
         interpret=interpret,
-    )(tables, lens, q[:, :, 0, :], k_pool, v_pool)
+    )(tables, lens, *operands)
     return out[:, :, None, :]
 
 

@@ -218,6 +218,15 @@ def paged_kv_spec(tp_axis: Optional[str] = "tp") -> P:
     return P(None, None, None, tp_axis, None)
 
 
+def paged_kv_scale_spec(tp_axis: Optional[str] = "tp") -> P:
+    """PartitionSpec for the int8 pool's per-block-per-group scale arrays
+    (`init_paged_kv_cache(dtype="int8")`: (L, num_blocks, G) f32): the
+    KV-group axis shards on `tp` exactly like the payload's
+    (`paged_kv_spec`), so each device dequantizes its own group-slice with
+    its own scale slice and the allocator stays device-count-blind."""
+    return P(None, None, tp_axis)
+
+
 def block_table_spec() -> P:
     """Block tables ((n_slots, max_blocks) int32) are replicated: every
     device resolves the same block ids — only the KV bytes shard."""

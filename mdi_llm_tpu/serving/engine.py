@@ -143,11 +143,18 @@ def _pin_kv(kv, sharding):
     Donation keeps the buffers where they are, but without the constraint
     GSPMD may pick a different output layout per executable — and the NEXT
     dispatch would retrace on the new input sharding, tripping the
-    CompileGuard zero-post-warmup-recompile contract."""
+    CompileGuard zero-post-warmup-recompile contract.  `sharding` is a
+    (pool, scale) pair: the int8 pool's 3-D scale leaves pin the matching
+    group-sharded layout (`paged_kv_scale_spec`); fp pools only ever see
+    the 5-D branch."""
     if sharding is None:
         return kv
+    pool_s, scale_s = sharding
     return jax.tree_util.tree_map(
-        lambda x: jax.lax.with_sharding_constraint(x, sharding), kv
+        lambda x: jax.lax.with_sharding_constraint(
+            x, pool_s if x.ndim == 5 else scale_s
+        ),
+        kv,
     )
 
 
@@ -175,6 +182,10 @@ class ServingStats:
     spec_accepted: int = 0  # draft tokens accepted (emitted without a step)
     requests_finished: int = 0
     preemptions: int = 0
+    # peak concurrently-resident sequences (live lanes holding pool blocks
+    # in one dispatch) — THE capacity number a quantized pool moves at
+    # fixed HBM (the serving-cb-int8 bench rung reads it off this field)
+    resident_peak: int = 0
     prefix_cache_hits: int = 0  # blocks reused copy-free
     wall_s: float = 0.0
     decode_s: float = 0.0
@@ -197,6 +208,9 @@ class ServingStats:
     def observe_mixed_occupancy(self, live: int, max_batch: int) -> None:
         self._occ_sum += live / max(1, max_batch)
         self._occ_n += 1
+
+    def observe_resident(self, live: int) -> None:
+        self.resident_peak = max(self.resident_peak, live)
 
     @property
     def padded_token_frac(self) -> float:
@@ -264,6 +278,7 @@ class ServingStats:
             "kv_block_utilization_peak": round(self.kv_utilization_peak, 4),
             "prefix_cache_hits": self.prefix_cache_hits,
             "preemptions": self.preemptions,
+            "resident_peak": self.resident_peak,
         }
 
 
@@ -295,6 +310,12 @@ class ServingEngine:
         # tp (Generator._paged_kv_sharding), the kernels run per shard
         self._tp = int(gen.mesh.shape.get("tp", 1)) if gen.mesh is not None else 1
         self._paged_shard = (gen.mesh, "tp") if self._tp > 1 else None
+        # (pool, scale) sharding pair for _pin_kv: fp pools only use the
+        # first element; the int8 pool's scale leaves pin the second
+        self._kv_sharding_pair = (
+            None if gen._paged_kv_sharding is None
+            else (gen._paged_kv_sharding, gen._paged_kv_scale_sharding)
+        )
         if (
             self._paged_shard is not None
             and serving.use_kernel
@@ -319,6 +340,34 @@ class ServingEngine:
                 "verify emits greedy successors, so only greedy streams are "
                 "exact (the shared_prefill reproducibility rule)"
             )
+        # pool storage dtype: kv_dtype=None keeps the fp path untouched
+        # (gen.cache_dtype, bit-identical to before the knob existed);
+        # "int8" builds the quantized pool; other float names cast on
+        # write.  Unknown names are refused through the same byte table
+        # the audit estimator uses (config.dtype_bytes), so the engine
+        # and mdi-audit can never disagree on what a kv_dtype means.
+        from mdi_llm_tpu.config import dtype_bytes
+        if serving.kv_dtype is None:
+            self._pool_dtype = gen.cache_dtype
+            self.kv_dtype_name = serving.resolved_kv_dtype(gen.cache_dtype)
+        else:
+            name = serving.resolved_kv_dtype()
+            dtype_bytes(name)  # ValueError on names the table doesn't know
+            if name == "int8":
+                self._pool_dtype = "int8"
+            elif name in ("float8", "float8_e4m3fn"):
+                self._pool_dtype = jnp.float8_e4m3fn
+            elif name == "float8_e5m2":
+                self._pool_dtype = jnp.float8_e5m2
+            elif name in ("bfloat16", "float16", "float32", "float64"):
+                self._pool_dtype = jnp.dtype(name)
+            else:
+                raise ValueError(
+                    f"kv_dtype {name!r} is not a paged-pool storage dtype: "
+                    "use 'int8' (quantized blocks + per-block scales) or a "
+                    "float dtype (cast on write)"
+                )
+            self.kv_dtype_name = name
         self.token_budget = serving.resolved_token_budget()
         if self.token_budget <= serving.max_batch:
             raise ValueError(
@@ -343,7 +392,7 @@ class ServingEngine:
         )
         self.scheduler.observer = obs  # lifecycle edges report from there
         self._kv = gen._place_paged_kv(transformer.init_paged_kv_cache(
-            gen.cfg, num_blocks, bs, dtype=gen.cache_dtype
+            gen.cfg, num_blocks, bs, dtype=self._pool_dtype
         ))
         # persistent host-side block table, updated incrementally as blocks
         # are appended / slots reassigned — rebuilding the full
@@ -396,7 +445,7 @@ class ServingEngine:
             # fn cache outlives this engine (gen._serve_fns) and capturing
             # self would pin its entire paged pool for the Generator's life
             shard = self._paged_shard
-            kv_sharding = gen._paged_kv_sharding
+            kv_sharding = self._kv_sharding_pair
 
             # float knobs ride as traced operands (see _decode_fn)
             @partial(
@@ -429,7 +478,7 @@ class ServingEngine:
             gen = self.gen
             use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
             shard = self._paged_shard
-            kv_sharding = gen._paged_kv_sharding
+            kv_sharding = self._kv_sharding_pair
 
             # float knobs ride as traced operands; the cache keys only on
             # (mode, top_k) — a per-request temperature sweep would otherwise
@@ -475,7 +524,7 @@ class ServingEngine:
             gen = self.gen
             use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
             shard = self._paged_shard
-            kv_sharding = gen._paged_kv_sharding
+            kv_sharding = self._kv_sharding_pair
 
             # float knobs ride as traced operands (see _decode_fn)
             @partial(
@@ -534,7 +583,7 @@ class ServingEngine:
             gen = self.gen
             use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
             shard = self._paged_shard
-            kv_sharding = gen._paged_kv_sharding
+            kv_sharding = self._kv_sharding_pair
 
             @partial(jax.jit, donate_argnums=(2,))
             def verify(params, tokens, kv, tables, pos0):
@@ -670,6 +719,7 @@ class ServingEngine:
         self.stats.host_syncs += 1
         self.stats.observe_dispatch(T, off)
         self.stats.observe_mixed_occupancy(len(live), B)
+        self.stats.observe_resident(len(self.scheduler.running()))
         self.stats.observe_kv_utilization(self.pool.utilization)
         if self.obs is not None:
             # one stamp at THIS boundary; every token/retirement below
@@ -779,6 +829,7 @@ class ServingEngine:
         self.stats.decode_steps += 1
         self.stats.host_syncs += 1
         self.stats.observe_dispatch(B, len(live))
+        self.stats.observe_resident(len(self.scheduler.running()))
         self.stats.observe_kv_utilization(self.pool.utilization)
         if self.obs is not None:
             self.obs.step(
@@ -822,6 +873,7 @@ class ServingEngine:
         chaining another speculative chunk."""
         self.stats.host_syncs += 1
         self.stats.observe_kv_utilization(self.pool.utilization)
+        self.stats.observe_resident(len(self.scheduler.running()))
         if self.obs is not None:
             # span start defaults to the previous boundary stamp — under
             # double-buffering the drained chunk's compute overlapped the
@@ -1014,6 +1066,7 @@ class ServingEngine:
         # (the padded_token_frac contract)
         self.stats.observe_dispatch(B * (K + 1), 0)
         self.stats.observe_kv_utilization(self.pool.utilization)
+        self.stats.observe_resident(len(self.scheduler.running()))
         for seq in live:
             d = drafts.get(seq.slot, [])
             # accept only over the REAL draft length: a 0-padded row must

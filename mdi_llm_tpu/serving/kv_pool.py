@@ -19,6 +19,14 @@ Block 0 is reserved as the write-only TRASH block: padded lanes and
 bucket-padding positions scatter their garbage K/V there
 (`ops.paged_attention.paged_update`), so it is never handed out.
 
+Dtype blindness (the quantized-pool contract): this allocator also never
+learns what the blocks store.  `ServingConfig(kv_dtype="int8")` swaps the
+device arrays for int8 payload + per-block-per-group scale arrays riding
+the same `(L, num_blocks, ...)` layout (`ops/paged_attention.py`), but a
+block id still means "block_size token slots" — free lists, refcounts and
+the hash-chain prefix cache are untouched, so a prefix hit reuses an int8
+block (payload AND scale) exactly as copy-free as an fp one.
+
 Device-count blindness (the tensor-parallel serving contract): this
 allocator never learns how many chips back the pool.  Under a tp mesh the
 device arrays shard their KV-GROUP axis (`parallel.sharding.paged_kv_spec`

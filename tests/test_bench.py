@@ -333,6 +333,60 @@ def test_train_mode_smoke():
     assert out["detail"]["final_loss"] == out["detail"]["final_loss"]  # not NaN
 
 
+def test_kernel_mode_smoke():
+    # the paged-attention microbench (suite row kernel-paged) on the CPU
+    # backend: kernel timings need a TPU so they record null, but the
+    # fallback/dense grid must land for all three dispatch shapes at BOTH
+    # pool dtypes — the acceptance contract that the in-kernel dequant
+    # cost is measured per dtype, not asserted
+    ap = bench.build_parser()
+    args = ap.parse_args(
+        ["--direct", "--mode", "kernel",
+         "--model", "pythia-14m", "--batch", "2", "--seq-len", "128"]
+    )
+    out = bench.run_kernel(args)
+    assert out["unit"] == "us" and out["value"] > 0
+    grid = out["detail"]["grid"]
+    assert set(grid) == {f"{op}-{t}" for op in ("decode", "ragged", "prefill")
+                         for t in ("fp", "int8")}
+    for row in grid.values():
+        assert row["fallback_us"] > 0
+    for op in ("decode", "ragged", "prefill"):
+        assert grid[f"{op}-fp"]["dense_us"] > 0
+        assert grid[f"{op}-int8"]["kernel_us"] is None  # CPU: no Pallas
+
+
+def test_serve_pool_mib_doubles_int8_blocks():
+    # the acceptance ratio through the engine-facing path: at the same
+    # --serve-pool-mib byte budget, the int8 pool's max_blocks (and so the
+    # resident sequences a block-bound pool holds) >= 1.8x the fp pool's
+    from mdi_llm_tpu.config import Config
+
+    cfg = Config.from_name("tiny-llama-1.1b")
+    ap = bench.build_parser()
+    blocks = {}
+    for dtype in ("auto", "int8"):
+        args = ap.parse_args(
+            ["--direct", "--mode", "serve", "--model", "tiny-llama-1.1b",
+             "--batch", "8", "--seq-len", "2048", "--kv-dtype", dtype,
+             "--serve-pool-mib", "24"]
+        )
+        blocks[dtype] = bench._serve_config(args, cfg).max_blocks
+    assert blocks["int8"] >= 1.8 * blocks["auto"]
+
+
+def test_suite_has_int8_and_kernel_rows():
+    rows = {r["name"]: r for r in bench.SUITE_ROWS}
+    q8 = rows["serving-cb-int8"]
+    assert "--kv-dtype" in q8["flags"] and "int8" in q8["flags"]
+    # fixed pool bytes: the row pins --serve-pool-mib so its fp_reference
+    # twin compares capacity at EQUAL budget, and the last ladder rung
+    # falls back to the fp pool
+    assert "--serve-pool-mib" in q8["flags"]
+    assert q8["ladder"][-1] == ["--kv-dtype", "auto"]
+    assert rows["kernel-paged"]["flags"][1] == "kernel"
+
+
 def test_banked_artifacts_attached_to_suite_output(monkeypatch):
     """Committed bench_results/ JSONs must surface in every suite output —
     including a CPU-fallback run on a dead backend — so the hardware

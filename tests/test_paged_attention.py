@@ -322,3 +322,177 @@ def test_gather_layout_roundtrip():
     pool_k, _, tables = build_pool(k, k, BS)
     out = gather_paged_kv(pool_k, tables)
     np.testing.assert_array_equal(np.asarray(out), k)
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized pool: {"q": int8 blocks, "scale": (NB, G) per-block scales}
+# ---------------------------------------------------------------------------
+
+
+def empty_q8_pool(nb, bs, g, hs):
+    return {"q": jnp.zeros((nb, bs, g, hs), jnp.int8),
+            "scale": jnp.zeros((nb, g), jnp.float32)}
+
+
+def build_q8_pool(k, v, block_size, seed=0):
+    """Quantize contiguous (B, G, S, hs) K/V into int8 pool dicts through
+    the REAL quantizing scatter (`paged_update`), one whole-range write per
+    sequence, with shuffled block placement like `build_pool`."""
+    B, G, S, hs = k.shape
+    mb = S // block_size
+    nb = 1 + B * mb + 2
+    rng = np.random.default_rng(seed)
+    ids = rng.permutation(np.arange(1, nb))[: B * mb].reshape(B, mb)
+    tables = jnp.asarray(ids, jnp.int32)
+    kp = empty_q8_pool(nb, block_size, G, hs)
+    vp = empty_q8_pool(nb, block_size, G, hs)
+    pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+    kp, vp = paged_update(
+        kp, vp, jnp.asarray(k).transpose(0, 2, 1, 3),
+        jnp.asarray(v).transpose(0, 2, 1, 3), tables, pos,
+    )
+    return kp, vp, tables
+
+
+def test_q8_update_roundtrip_error_bounded():
+    """Quantize-on-scatter then gather-dequantize must reproduce the
+    written values within half the block's scale per entry — the symmetric
+    int8 rounding bound, per (block, group)."""
+    B, G, hs, S, BS = 2, 3, 8, 24, 4
+    rng = np.random.default_rng(1)
+    k = rng.standard_normal((B, G, S, hs)).astype(np.float32)
+    kp, _, tables = build_q8_pool(k, k, BS)
+    got = np.asarray(gather_paged_kv(kp, tables))  # (B, G, S, hs)
+    scale = np.asarray(kp["scale"])[np.asarray(tables)]  # (B, MB, G)
+    bound = np.repeat(scale, BS, axis=1).transpose(0, 2, 1)  # (B, G, S)
+    assert np.all(np.abs(got - k) <= 0.5 * bound[..., None] + 1e-7)
+    # and the bound is tight enough to matter: scales track the data
+    assert np.all(scale > 0)
+
+
+def test_q8_rewrite_same_value_is_byte_idempotent():
+    """The frozen-lane contract: re-scattering the SAME (token, position)
+    pair must leave payload bytes AND scales bit-identical (the chunked
+    decode scan rewrites frozen lanes every step)."""
+    B, G, hs, S, BS = 2, 2, 8, 16, 4
+    rng = np.random.default_rng(3)
+    k = rng.standard_normal((B, G, S, hs)).astype(np.float32)
+    kp, vp, tables = build_q8_pool(k, k, BS)
+    knew = jnp.asarray(k).transpose(0, 2, 1, 3)
+    for p in (0, 7, S - 1):
+        pos = jnp.full((B, 1), p, jnp.int32)
+        k2, v2 = paged_update(
+            kp, vp, knew[:, p : p + 1], knew[:, p : p + 1], tables, pos
+        )
+        np.testing.assert_array_equal(np.asarray(k2["q"]), np.asarray(kp["q"]))
+        np.testing.assert_array_equal(
+            np.asarray(k2["scale"]), np.asarray(kp["scale"])
+        )
+
+
+def test_q8_scale_growth_requantizes_block():
+    """Appending a larger-magnitude token to a block grows its scale
+    monotonically and requantizes the block's existing entries under the
+    new scale — older values stay within the (grown) rounding bound
+    instead of silently dequantizing wrong."""
+    G, hs, BS, NB = 1, 4, 4, 3
+    kp = empty_q8_pool(NB, BS, G, hs)
+    vp = empty_q8_pool(NB, BS, G, hs)
+    tables = jnp.asarray([[1]], jnp.int32)
+    small = jnp.full((1, 1, G, hs), 0.1, jnp.float32)
+    big = jnp.full((1, 1, G, hs), 10.0, jnp.float32)
+    kp, vp = paged_update(kp, vp, small, small, tables,
+                          jnp.asarray([[0]], jnp.int32))
+    s0 = float(kp["scale"][1, 0])
+    kp, vp = paged_update(kp, vp, big, big, tables,
+                          jnp.asarray([[1]], jnp.int32))
+    s1 = float(kp["scale"][1, 0])
+    assert s1 > s0  # scale grew with the bigger token
+    deq = np.asarray(kp["q"][1].astype(jnp.float32)) * s1
+    # the first token survived the requantization within the NEW bound
+    # (one extra re-rounding: <= old half-ulp rescaled + new half-ulp)
+    assert abs(deq[0, 0, 0] - 0.1) <= 0.5 * s0 + 0.5 * s1 + 1e-7
+    assert abs(deq[1, 0, 0] - 10.0) <= 0.5 * s1 + 1e-7
+
+
+def test_q8_update_trash_redirect():
+    """Positions past the table's coverage land in trash block 0 only —
+    live int8 blocks (payload and scale) stay untouched."""
+    G, hs, BS, MB, NB = 2, 4, 4, 2, 6
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((1, G, MB * BS, hs)).astype(np.float32)
+    kp, vp, tables = build_q8_pool(k, k, BS)
+    new = jnp.asarray(rng.standard_normal((1, 1, G, hs)), jnp.float32)
+    pos = jnp.asarray([[MB * BS + 1]], jnp.int32)  # past coverage
+    k2, _ = paged_update(kp, vp, new, new, tables, pos)
+    np.testing.assert_array_equal(
+        np.asarray(k2["q"][1:]), np.asarray(kp["q"][1:])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(k2["scale"][1:]), np.asarray(kp["scale"][1:])
+    )
+
+
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1)],
+                         ids=["mha", "gqa", "mqa"])
+def test_q8_decode_kernel_matches_fallback(heads):
+    """The Pallas decode kernel's IN-LOOP dequant (int8 block × per-group
+    scale, f32) must agree with the gather-dequantize fallback — the same
+    parity contract the fp pool pins, now at int8."""
+    H, G = heads
+    B, hs, S, BS = 2, 16, 32, 8
+    q, k, v = rand_qkv(B, H, G, S, hs, Tq=1, seed=7)
+    kp, vp, tables = build_q8_pool(np.asarray(k), np.asarray(v), BS)
+    q_pos = jnp.asarray([[13], [30]], jnp.int32)
+    ref = paged_attention(q, kp, vp, tables, q_pos, use_kernel=False)
+    got = paged_attention(q, kp, vp, tables, q_pos, use_kernel=True,
+                          interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5
+    )
+    # the dequantized attention itself stays near the fp dense op: the
+    # per-layer max-abs drift of int8 KV is bounded by the block scales
+    dense = multihead_attention(q, k, v, q_pos)
+    assert np.max(np.abs(np.asarray(ref) - np.asarray(dense))) < 0.05
+
+
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1)],
+                         ids=["mha", "gqa", "mqa"])
+def test_q8_ragged_kernel_matches_fallback(heads):
+    """Ragged multi-query decode (the speculative-verify shape) over an
+    int8 pool: kernel == fallback."""
+    H, G = heads
+    B, hs, S, BS, Tq = 2, 16, 32, 8, 5
+    q, k, v = rand_qkv(B, H, G, S, hs, Tq=Tq, seed=11)
+    kp, vp, tables = build_q8_pool(np.asarray(k), np.asarray(v), BS)
+    q_pos = jnp.asarray([np.arange(9, 9 + Tq), np.arange(21, 21 + Tq)],
+                        jnp.int32)
+    ref = paged_attention(q, kp, vp, tables, q_pos, use_kernel=False)
+    got = paged_attention(q, kp, vp, tables, q_pos, use_kernel=True,
+                          interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1)],
+                         ids=["mha", "gqa", "mqa"])
+def test_q8_prefill_kernel_matches_fallback(heads):
+    """The unified mixed step's ragged prefill kernel over an int8 pool:
+    per-slot spans, in-loop dequant, masked scratch — kernel == fallback
+    on every real packed row."""
+    H, G = heads
+    hs, S, BS, T = 16, 32, 8, 12
+    q, k, v = rand_qkv(3, H, G, S, hs, Tq=1, seed=13)
+    kp, vp, tables = build_q8_pool(np.asarray(k), np.asarray(v), BS)
+    qp, q_slot, q_start, q_len, q_pos, off = _pack_mixed(
+        [(0, 30, 1), (1, 0, 6), (2, 17, 3)], H, hs, T, seed=17
+    )
+    ref = paged_prefill(qp, kp, vp, tables, q_slot, q_start, q_len,
+                        q_pos, use_kernel=False)
+    got = paged_prefill(qp, kp, vp, tables, q_slot, q_start, q_len,
+                        q_pos, use_kernel=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref)[0, :, :off], np.asarray(got)[0, :, :off],
+        rtol=2e-5, atol=2e-5,
+    )

@@ -1,0 +1,373 @@
+"""Quantized paged-KV pool (ServingConfig.kv_dtype="int8") interacting
+with the full serving machinery: greedy drift vs the fp engine is pinned
+(per-layer max-abs error bound + token-match-rate floor), the fp path
+stays structurally untouched, prefix caching / preemption-recompute /
+frozen-lane chunked decode / speculative verify all run over int8 blocks,
+the pool roughly doubles its blocks at a fixed HBM budget, mdi-audit's
+byte accounting stays exact against the live quantized pool (single
+device and per-device under tp), and CompileGuard shows zero post-warmup
+recompiles with int8 enabled on the full mixed trace."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.config import Config, ServingConfig
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import init_params
+from mdi_llm_tpu.parallel.mesh import make_mesh
+from mdi_llm_tpu.utils.profiling import CompileGuard
+from tests.test_model import tiny_config
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _trace(cfg, lengths, seed=5):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, int(n)).tolist() for n in lengths]
+
+
+def _run_engine(gen, prompts, max_news, **knobs):
+    engine = gen.serve(**knobs)
+    for i, (p, m) in enumerate(zip(prompts, max_news)):
+        engine.add_request(f"r{i}", p, m)
+    results, stats = engine.run()
+    return [results[f"r{i}"] for i in range(len(prompts))], stats, engine
+
+
+def _match_rate(want, got, prompts):
+    """Aggregate longest-matching-prefix rate over the generated suffixes —
+    the drift metric of the acceptance criterion (post-divergence tokens
+    never count as matches)."""
+    total = match = 0
+    for w, g, p in zip(want, got, prompts):
+        a, b = w[len(p):], g[len(p):]
+        n = 0
+        while n < min(len(a), len(b)) and a[n] == b[n]:
+            n += 1
+        match += n
+        total += max(len(a), 1)
+    return match / total
+
+
+# ---------------------------------------------------------------------------
+# greedy drift vs the fp engine (the quality half of the acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+def test_int8_engine_matches_fp_engine_streams(model):
+    """Mixed-length serving-cb-style trace: the int8 engine's greedy
+    streams must match the fp engine's at >= 99% token-match rate."""
+    cfg, params = model
+    prompts = _trace(cfg, (3, 9, 17, 5, 33))
+    max_news = [8, 12, 6, 10, 7]
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=8)
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    fp, _, _ = _run_engine(gen, prompts, max_news, **knobs)
+    q8, stats, engine = _run_engine(
+        gen, prompts, max_news, kv_dtype="int8", **knobs
+    )
+    assert _match_rate(fp, q8, prompts) >= 0.99
+    assert stats.requests_finished == len(prompts)
+    assert engine.kv_dtype_name == "int8"
+    assert engine.pool.used == 0  # every retirement released int8 blocks
+
+
+def test_int8_pool_drift_bounded_per_layer(model):
+    """Per-layer max-abs error bound: after identical traces, every live
+    entry of the dequantized int8 pool sits within 2 scales of the fp
+    engine's pool (0.5 scale of direct rounding plus re-rounding slack
+    from monotone scale growth) — blocks are placed identically because
+    the allocator is dtype-blind."""
+    cfg, params = model
+    prompts = _trace(cfg, (5, 19, 11))
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=8)
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    _, _, fp_eng = _run_engine(gen, prompts, [6, 6, 6], **knobs)
+    _, _, q8_eng = _run_engine(
+        gen, prompts, [6, 6, 6], kv_dtype="int8", **knobs
+    )
+    for side in ("k", "v"):
+        fp_pool = np.asarray(fp_eng._kv[side])  # (L, NB, BS, G, hs)
+        q = np.asarray(q8_eng._kv[side]["q"], np.float32)
+        s = np.asarray(q8_eng._kv[side]["scale"])  # (L, NB, G)
+        deq = q * s[:, :, None, :, None]
+        err = np.abs(deq - fp_pool)[:, 1:]  # trash block 0 is garbage
+        bound = 2.0 * s[:, 1:, None, :, None] + 1e-6
+        L = fp_pool.shape[0]
+        for layer in range(L):
+            assert np.all(err[layer] <= bound[layer]), (
+                f"{side} layer {layer}: max-abs drift "
+                f"{err[layer].max():.4g} exceeds 2x scale bound"
+            )
+
+
+def test_fp_path_structurally_untouched(model):
+    """kv_dtype=None keeps the fp pool bit-identical to before the knob
+    existed: bare arrays at the cache dtype, no scale leaves, and the
+    engine resolves the dtype name from the Generator."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = gen.serve(block_size=4, max_batch=2)
+    assert isinstance(engine._kv["k"], jnp.ndarray)
+    assert engine._kv["k"].dtype == jnp.float32
+    assert engine.kv_dtype_name == "float32"
+
+
+def test_unknown_kv_dtype_refused(model):
+    """kv_dtype names the byte table doesn't know are refused at engine
+    construction (the same dtype_bytes wall mdi-audit uses)."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="unknown dtype"):
+        gen.serve(block_size=4, max_batch=2, kv_dtype="int9")
+    # known-but-non-storage dtypes are refused with the actionable message
+    with pytest.raises(ValueError, match="not a paged-pool storage dtype"):
+        gen.serve(block_size=4, max_batch=2, kv_dtype="int32")
+    with pytest.raises(ValueError, match="unknown dtype"):
+        ServingConfig(kv_dtype="int9").block_bytes(cfg)
+
+
+# ---------------------------------------------------------------------------
+# int8 blocks x existing machinery
+# ---------------------------------------------------------------------------
+
+
+def test_int8_chunked_decode_token_identical_to_per_step(model):
+    """Chunked decode over an int8 pool is BIT-identical to the per-step
+    int8 engine: frozen lanes rewrite the same quantized bytes (monotone
+    scales make the rewrite idempotent), so the multi-token scan changes
+    nothing — the same contract the fp engine pins, surviving
+    quantization."""
+    cfg, params = model
+    prompts = _trace(cfg, (3, 9, 17), seed=7)
+    max_news = [10, 6, 12]
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    base = dict(block_size=4, max_batch=3, prefill_chunk=8, kv_dtype="int8")
+    want, _, _ = _run_engine(gen, prompts, max_news, decode_chunk=1, **base)
+    for buffered in (False, True):
+        got, stats, _ = _run_engine(
+            gen, prompts, max_news, decode_chunk=4,
+            double_buffer=buffered, **base,
+        )
+        assert got == want
+        assert stats.tokens_per_sync > 1.0
+
+
+def test_int8_prefix_cache_reuses_quantized_blocks(model):
+    """A prefix-cache hit reuses int8 blocks (payload AND scale) copy-free:
+    the second identical prompt skips its cached blocks' prefill and still
+    emits the identical greedy stream."""
+    cfg, params = model
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, cfg.vocab_size, 21).tolist()
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = gen.serve(block_size=4, max_batch=1, prefill_chunk=8,
+                       kv_dtype="int8")
+    engine.add_request("a", prompt, 8)
+    engine.add_request("b", prompt, 8)
+    results, stats = engine.run()
+    assert stats.prefix_cache_hits > 0
+    assert results["a"] == results["b"]
+
+
+def test_int8_preemption_recompute_roundtrip(model):
+    """A pool-pressure preemption recomputes the victim's prompt+progress
+    into FRESH int8 blocks; the resumed stream must stay on the
+    non-preempted int8 engine's tokens at >= 99% match (recompute
+    re-quantizes under possibly different block groupings, so bit equality
+    is not the contract — bounded drift is)."""
+    cfg, params = model
+    prompts = _trace(cfg, (9, 13, 11), seed=9)
+    max_news = [10, 10, 10]
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    base = dict(block_size=4, prefill_chunk=8, kv_dtype="int8",
+                prefix_caching=False, decode_chunk=1)
+    want, _, _ = _run_engine(gen, prompts, max_news, max_batch=3, **base)
+    # the pool sizing that forces the per-step engine's one-block-at-a-time
+    # growth dry (test_engine_preemption_preserves_parity's recipe)
+    got, stats, engine = _run_engine(
+        gen, prompts, max_news, max_batch=3, max_blocks=1 + 14, **base,
+    )
+    assert stats.preemptions > 0
+    assert engine.pool.used == 0
+    assert _match_rate(want, got, prompts) >= 0.99
+    assert all(len(g) > len(p) for g, p in zip(got, prompts))
+
+
+def test_int8_speculative_verify_over_quantized_pool(model):
+    """spec_k batched verify dispatches the ragged multi-query forward over
+    the int8 pool; accepted bursts keep the stream on the plain int8
+    engine's greedy tokens (>= 99% — a rejected draft's write can ratchet
+    a tail block's scale, so bit equality is not guaranteed)."""
+    cfg, params = model
+    # prompts whose greedy continuation echoes earlier context (the tiny
+    # random model falls into cycles), so n-gram drafting genuinely fires —
+    # test_serving._cycling_prompts' recipe
+    prompts = [np.random.default_rng(s).integers(1, cfg.vocab_size, 5).tolist()
+               for s in (5, 7)]
+    max_news = [40, 35]
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    base = dict(block_size=4, max_batch=2, prefill_chunk=8, kv_dtype="int8")
+    want, _, _ = _run_engine(gen, prompts, max_news, **base)
+    got, stats, _ = _run_engine(gen, prompts, max_news, spec_k=4, **base)
+    assert stats.spec_drafted > 0
+    assert _match_rate(want, got, prompts) >= 0.99
+
+
+def test_int8_zero_postwarmup_recompiles_mixed_trace(model):
+    """The CompileGuard half of the acceptance bar: a warmup int8 engine
+    and its timed twin share the jit cache; the full mixed trace (prefill
+    chunks + decode + retirement) builds no new executable after warmup —
+    donation round-trips keep the quantized pool's pytree layout."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    prompts = _trace(cfg, (3, 9, 17), seed=17)
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=8,
+                 decode_chunk=4, kv_dtype="int8")
+
+    def drive(engine):
+        for i, p in enumerate(prompts):
+            engine.add_request(f"r{i}", p, 8)
+        engine.run()
+
+    guard = CompileGuard(label="int8-serve")
+    with guard:
+        drive(gen.serve(**knobs))
+        guard.mark_warm()
+        drive(gen.serve(**knobs))
+    assert guard.traces_after_warmup == 0
+    assert guard.backend_compiles_after_warmup == 0
+    guard.expect_clean()
+
+
+# ---------------------------------------------------------------------------
+# capacity + byte accounting (the HBM half of the acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+def test_int8_blocks_roughly_double_at_fixed_budget():
+    """At a fixed --hbm-gb budget, the int8 pool admits >= 1.8x the blocks
+    of the fp pool (and therefore >= 1.8x the resident sequences a block-
+    bound pool can hold) — through the ONE itemized bytes-per-block helper
+    the audit fit and the estimates share, scale arrays included."""
+    cfg = Config.from_name("tiny-llama-1.1b")
+    from mdi_llm_tpu.analysis.audit import preflight
+
+    fits = {}
+    for name, kv_dtype in (("fp", None), ("int8", "int8")):
+        sv = ServingConfig(kv_dtype=kv_dtype)
+        report = preflight(cfg, batch=8, seq_len=512, serving=sv,
+                           hbm_gb=8.0, quantize="int8")
+        fits[name] = report.breakdown["fits"]["max_pool_blocks"]
+        assert report.breakdown["kv_pool"]["blocks_at_budget"] == fits[name]
+    assert fits["int8"] >= 1.8 * fits["fp"]
+    # the per-block ratio itself: ~2x for bf16 -> int8 at hs=64
+    bfp = ServingConfig().block_bytes(cfg, "bfloat16")
+    b8 = ServingConfig(kv_dtype="int8").block_bytes(cfg, "bfloat16")
+    assert b8["scale_bytes"] > 0 and bfp["scale_bytes"] == 0
+    assert bfp["total_bytes"] >= 1.8 * b8["total_bytes"]
+
+
+def test_audit_pool_bytes_exact_vs_live_int8_engine(model):
+    """mdi-audit's pool estimate (payload + scale arrays) must equal the
+    live quantized engine's device bytes EXACTLY, and the breakdown's
+    scale_bytes must equal the scale leaves alone."""
+    cfg, params = model
+    sv = ServingConfig(block_size=4, max_batch=3, prefill_chunk=8,
+                       kv_dtype="int8")
+    from mdi_llm_tpu.analysis.audit import preflight
+
+    report = preflight(cfg, batch=3, seq_len=128, cache_dtype="float32",
+                       serving=sv)
+    assert not report.errors
+    pool = report.breakdown["kv_pool"]
+    assert pool["kv_dtype"] == "int8"
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = gen.serve(serving=sv)
+    leaves = jax.tree_util.tree_leaves(engine._kv)
+    live_total = sum(int(x.nbytes) for x in leaves)
+    live_scales = sum(
+        int(side["scale"].nbytes) for side in engine._kv.values()
+    )
+    assert pool["pool_bytes"] == live_total
+    assert pool["scale_bytes"] == live_scales
+    assert report.breakdown["per_device"]["kv_bytes"] == live_total
+
+
+def test_audit_pool_bytes_exact_per_device_under_tp(model, devices):
+    """Under a tp mesh the int8 pool shards its KV-group axis — scale
+    arrays included (paged_kv_scale_spec) — and the audit's per-device
+    estimate equals the bytes actually resident on one device's shards."""
+    cfg, params = model
+    sv = ServingConfig(block_size=4, max_batch=3, prefill_chunk=8,
+                       kv_dtype="int8")
+    from mdi_llm_tpu.analysis.audit import preflight
+
+    report = preflight(cfg, tp=2, batch=3, seq_len=128,
+                       cache_dtype="float32", serving=sv)
+    assert not report.errors
+    pool = report.breakdown["kv_pool"]
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"tp": 2}, devices[:2]))
+    engine = gen.serve(serving=sv)
+    leaves = jax.tree_util.tree_leaves(engine._kv)
+    live_total = sum(int(x.nbytes) for x in leaves)
+    dev0 = devices[0]
+    live_dev = sum(
+        int(s.data.nbytes)
+        for x in leaves for s in x.addressable_shards if s.device == dev0
+    )
+    assert pool["tp"] == 2
+    assert pool["pool_bytes"] == live_total
+    assert pool["pool_bytes_per_device"] == live_total // 2 == live_dev
+    # the scale leaves really are group-sharded, not replicated
+    for side in engine._kv.values():
+        assert "tp" in str(side["scale"].sharding.spec)
+
+
+def test_int8_engine_runs_under_tp_mesh(model, devices):
+    """The sharded engine serves an int8 pool with streams matching the
+    single-device int8 engine token-for-token (per-head math never crosses
+    a shard, and each device dequantizes with its own scale slice)."""
+    cfg, params = model
+    prompts = _trace(cfg, (3, 9), seed=23)
+    knobs = dict(block_size=4, max_batch=2, kv_dtype="int8")
+    single = Generator(cfg, params, cache_dtype=jnp.float32)
+    want, _, _ = _run_engine(single, prompts, [8, 8], **knobs)
+    tp = Generator(cfg, params, cache_dtype=jnp.float32,
+                   mesh=make_mesh({"tp": 2}, devices[:2]))
+    got, _, engine = _run_engine(tp, prompts, [8, 8], **knobs)
+    assert got == want
+    assert "tp" in str(engine._kv["k"]["q"].sharding.spec)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_help_covers_kv_dtype_int8():
+    """--kv-dtype int8 is documented on mdi-serve, bench, and mdi-audit;
+    the dense-cache entry points refuse it."""
+    import bench
+    from mdi_llm_tpu.analysis.audit import build_parser as audit_parser
+    from mdi_llm_tpu.cli.sample import build_parser as sample_parser
+    from mdi_llm_tpu.cli.serve import build_parser as serve_parser
+
+    # collapse argparse's line wrapping before matching phrases
+    serve_help = " ".join(serve_parser().format_help().split())
+    assert "int8" in serve_help and "per-block" in serve_help
+    bench_help = " ".join(bench.build_parser().format_help().split())
+    assert "Quantized paged KV" in bench_help and "kernel" in bench_help
+    audit_help = " ".join(audit_parser().format_help().split())
+    assert "int8" in audit_help and "quantized pool" in audit_help
+    # dense entry points keep the original choices (argparse refuses int8)
+    with pytest.raises(SystemExit):
+        sample_parser().parse_args(["--kv-dtype", "int8"])
