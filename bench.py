@@ -109,6 +109,12 @@ def build_parser():
                     help="suite mode: probe attempts AFTER the first (each "
                     "separated by a 60 s sleep); env MDI_BENCH_PROBE_RETRIES "
                     "overrides the default")
+    ap.add_argument("--doctor", action="store_true",
+                    help="suite mode: run the staged `mdi-doctor --quick` "
+                    "backend triage (each stage its own subprocess under a "
+                    "hard timeout) before probing, and embed the health "
+                    "snapshot as detail.doctor — diagnostic only, the "
+                    "--probe result still decides the CPU fallback")
     ap.add_argument("--backend", choices=("auto", "cpu"), default="auto",
                     help="cpu: force the CPU backend via jax.config (the "
                     "JAX_PLATFORMS env var is pinned to the TPU plugin by "
@@ -302,9 +308,15 @@ def run_preflight(args, cfg, mode):
 
 
 def run_probe():
-    """Backend bring-up check: device enumeration + one tiny compiled op."""
+    """Backend bring-up check: device enumeration + one tiny compiled op.
+    The detail block doubles as the suite's device-provenance record:
+    device_kind keys the `obs/roofline.py` peak table, and the versions
+    say WHICH toolchain produced the row (the r03-r05 wedge was
+    undiagnosable partly because no artifact recorded either)."""
     import jax
     import jax.numpy as jnp
+
+    from mdi_llm_tpu.cli.doctor import _package_versions
 
     t0 = time.perf_counter()
     devs = jax.devices()
@@ -315,7 +327,13 @@ def run_probe():
         "value": round(time.perf_counter() - t0, 2),
         "unit": "s",
         "vs_baseline": 1.0,
-        "detail": {"backend": jax.default_backend(), "device": str(devs[0])},
+        "detail": {
+            "backend": jax.default_backend(),
+            "device": str(devs[0]),
+            "device_kind": getattr(devs[0], "device_kind", None),
+            "device_count": len(devs),
+            "versions": _package_versions(),
+        },
     }
 
 
@@ -328,8 +346,12 @@ def run_train(args):
     and the FA-2 recompute backward, so one green run of
     ``bench.py --direct --mode train --seq-len 2048`` IS the flash-VJP
     on-hardware proof (compare --train-flash on/off for the crossover).
-    MFU baseline 1.0 = the v5e bf16 peak (~197 TFLOP/s); vs_baseline
-    reports the measured model-FLOPs utilization.
+    vs_baseline reports measured model-FLOPs utilization against the
+    RUNNING device's bf16 peak from the `obs/roofline.py` peak table
+    (the one source train and serve rows share); unknown device kinds
+    (CPU fallback) fall back to the v5e peak, labelled "assumed" in
+    detail.peak_source, so the flagship row stays comparable across
+    rounds.
     """
     import jax
     import numpy as np
@@ -373,8 +395,22 @@ def run_train(args):
     toks_per_step = args.batch * args.seq_len
     tps = args.train_steps * toks_per_step / wall
     flops_tok = estimate_flops_per_token(cfg, args.seq_len)
-    V5E_BF16_PEAK = 197e12
-    mfu = tps * flops_tok / V5E_BF16_PEAK
+    # MFU against the RUNNING chip's peak (obs/roofline.py — the table
+    # serve rows use too); unknown kinds fall back to the historical v5e
+    # reference so CPU-fallback rows stay comparable, clearly labelled
+    from mdi_llm_tpu.obs.roofline import (
+        ASSUMED_TRAIN_PEAK_KIND, DEVICE_PEAKS, device_peaks,
+    )
+
+    kind = getattr(jax.devices()[0], "device_kind", None)
+    peaks = device_peaks(kind)
+    peak_source = (
+        kind if peaks is not None
+        else f"{ASSUMED_TRAIN_PEAK_KIND} (assumed; device kind {kind!r} "
+        "not in the peak table)"
+    )
+    peak = (peaks or DEVICE_PEAKS[ASSUMED_TRAIN_PEAK_KIND])["bf16_tflops"] * 1e12
+    mfu = tps * flops_tok / peak
     return {
         "metric": f"train tokens/sec/chip ({args.model}, B={args.batch}, "
                   f"T={args.seq_len}, flash={trainer.use_flash})",
@@ -382,7 +418,9 @@ def run_train(args):
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 6),
         "detail": {
-            "mfu_vs_v5e_bf16_peak": round(mfu, 6),
+            "mfu": round(mfu, 6),
+            "peak_tflops_per_s": peak / 1e12,
+            "peak_source": peak_source,
             "tflops_per_s": round(tps * flops_tok / 1e12, 2),
             "steps": args.train_steps,
             "step_s": round(wall / args.train_steps, 4),
@@ -585,8 +623,14 @@ def run_serve(args):
     # are all prompt-independent now — ONE (1, token_budget) unified mixed
     # step (no per-prompt-bucket prefill fns), the fixed (B, decode_chunk)
     # scan, and, with spec_k, the verify width — so the timed run below
-    # reports zero post-warmup recompiles
-    warm = build_engine()
+    # reports zero post-warmup recompiles.  The warmup observer captures
+    # each executable's XLA cost sheet (device=True, obs/device.py): the
+    # AOT introspection compiles HERE, caches on the Generator, and the
+    # timed engine's observer republishes the reports without lowering
+    # anything — detail.device stays inside the CompileGuard contract
+    from mdi_llm_tpu.obs import ServingObserver
+
+    warm = build_engine(obs=ServingObserver(device=True))
     for rid, prompt, new in trace:
         warm.add_request(
             rid, prompt, min(new, max(2, 2 * args.serve_chunk))
@@ -600,12 +644,10 @@ def run_serve(args):
     # token-match rate of the quantized streams against the fp ones.  It
     # runs (and compiles) BEFORE the warm mark so the timed int8 region
     # below still reports zero post-warmup recompiles
-    from mdi_llm_tpu.obs import ServingObserver
-
     fp_results, fp_ref = None, None
     if pool_int8:
         sv_fp = _serve_config(args, cfg, kv_dtype=None)
-        fp_warm = build_engine(serving=sv_fp)
+        fp_warm = build_engine(obs=ServingObserver(device=True), serving=sv_fp)
         for rid, prompt, new in trace:
             fp_warm.add_request(
                 rid, prompt, min(new, max(2, 2 * args.serve_chunk))
@@ -637,9 +679,9 @@ def run_serve(args):
     # observe the TIMED engine only: per-request TTFT/TPOT/E2E/queue-wait
     # percentiles ride into detail.latency (hooks fire at the engine's
     # existing sync boundaries — zero extra syncs/compiles, so the
-    # CompileGuard row contract is untouched; docs/observability.md)
-    from mdi_llm_tpu.obs import ServingObserver
-
+    # CompileGuard row contract is untouched; docs/observability.md).
+    # NOT device=True: the warmup observer already captured (and cached)
+    # every executable report; this one only republishes them
     obs = ServingObserver()
     engine = build_engine(obs=obs)
     for rid, prompt, new in trace:
@@ -670,6 +712,52 @@ def run_serve(args):
     total = stats.tokens_generated / wall if wall else 0.0
     value = total / n_chips  # tokens/s/CHIP: the cross-topology comparable
     base = baseline_for(args.model)
+
+    # device-side block (docs/observability.md "Device-side"): the XLA
+    # executable cost sheets captured at warmup, the achieved MFU/MBU
+    # roofline at the run's mean context, and the analytic-vs-XLA FLOPs
+    # cross-check that keeps the hand model honest
+    from mdi_llm_tpu.obs import roofline as rf
+
+    dev0 = jax.devices()[0]
+    kind = getattr(dev0, "device_kind", None)
+    # effective context per generated token ≈ prompt + half the generation
+    ctxs = [
+        len(p) + max(0, len(results.get(rid, [])) - len(p)) / 2
+        for rid, p, _new in trace
+    ]
+    ctx_mean = int(sum(ctxs) / max(1, len(ctxs)))
+    # weight streams amortize over the lanes actually live per step
+    eff_batch = (
+        max(1, round(stats.mixed_batch_occupancy * args.batch))
+        if stats.mixed_batch_occupancy else args.batch
+    )
+    roof = rf.serving_roofline(
+        cfg, serving_cfg, tokens_per_s=total, context=ctx_mean,
+        batch=eff_batch, weight_bytes=rf.param_bytes(gen.params),
+        device_kind=kind, n_chips=n_chips, dtype=args.dtype,
+    )
+    mixed_rep = obs.device.get(
+        "mixed", (args.batch, engine.token_budget), engine.kv_dtype_name
+    )
+    cross = None
+    if mixed_rep is not None:
+        # the mixed executable computes token_budget positions, each
+        # attending the full table window (the fallback gathers every
+        # covered block) — that is the shape the analytic model must match
+        window = engine.max_blocks_per_seq * engine.pool.block_size
+        cross = rf.crosscheck_flops(
+            mixed_rep,
+            engine.token_budget * rf.decode_flops_per_token(cfg, window),
+        )
+    device_block = {
+        "name": str(dev0),
+        "kind": kind,
+        "platform": jax.default_backend(),
+        "roofline": roof,
+        "executables": obs.device.to_dict(),
+        "crosscheck": cross,
+    }
     tp_tag = f", tp={args.tp}" if args.tp > 1 else ""
     # canonical serving stats (ServingStats.to_dict — same dict mdi-serve
     # prints) + bench extras; the percentile block is the production
@@ -700,7 +788,7 @@ def run_serve(args):
             "pool_mib": args.serve_pool_mib,
             "quantize": args.quantize,
         },
-        "device": str(jax.devices()[0]),
+        "device": device_block,
     })
     if fp_ref is not None:
         detail["fp_reference"] = fp_ref
@@ -1192,6 +1280,22 @@ def run_suite(args):
         events.append(f"[{elapsed():.0f}s] {msg}")
         print(f"bench: {msg}", file=sys.stderr, flush=True)
 
+    # --- optional staged triage before any probe (bench --doctor) ---
+    # each doctor stage runs in its own subprocess under its own hard
+    # timeout, so even a wedged libtpu costs bounded suite time and the
+    # artifact records WHICH bring-up stage wedged (cli/doctor.py)
+    doctor_snap = None
+    if getattr(args, "doctor", False):
+        from mdi_llm_tpu.cli.doctor import collect_snapshot
+
+        note("mdi-doctor --quick preflight")
+        doctor_snap = collect_snapshot(quick=True)
+        stage_line = " ".join(
+            f"{r['name']}={r['status']}" for r in doctor_snap["stages"]
+        )
+        note(f"doctor: {'healthy' if doctor_snap['ok'] else 'UNHEALTHY'} "
+             f"({stage_line})")
+
     # --- backend bring-up with retry-after-sleep in fresh interpreters ---
     # --probe-timeout is a HARD TOTAL cap, not a per-attempt window:
     # BENCH_r05 burned 900 s of a 1140 s suite because each attempt got the
@@ -1316,8 +1420,15 @@ def run_suite(args):
     else:
         out = {"metric": "decode tokens/sec/chip (no measurement succeeded)",
                "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0}
+    # provenance header: versions/host/env captured WITHOUT touching any
+    # backend (importlib.metadata only) so even a suite that dies on a
+    # dead backend records what environment produced it — trajectory
+    # JSONs become comparable across machines/toolchains
+    from mdi_llm_tpu.cli.doctor import provenance
+
     out["detail"] = {
         "rows": rows,
+        "provenance": provenance(),
         "probe": {
             "attempts": probe_attempts,
             "budget_s": args.probe_timeout,
@@ -1334,6 +1445,8 @@ def run_suite(args):
         "suite_wall_s": round(elapsed(), 1),
         "events": events,
     }
+    if doctor_snap is not None:
+        out["detail"]["doctor"] = doctor_snap
     banked = collect_banked_artifacts()
     if banked:
         out["detail"]["banked_artifacts"] = banked
@@ -1357,13 +1470,20 @@ def collect_banked_artifacts():
                 data = json.load(fh)
             detail = data.get("detail") if isinstance(data, dict) else None
             rows_b = detail.get("rows") if isinstance(detail, dict) else None
+            def _device_of(v):
+                detail = v.get("detail")
+                if not isinstance(detail, dict):
+                    return None
+                dev = detail.get("device")
+                # serve rows carry a device BLOCK since PR 10; the banked
+                # summary wants the one-line identity either way
+                return dev.get("name") if isinstance(dev, dict) else dev
+
             keep = {
                 k: {
                     "value": v.get("value"),
                     "unit": v.get("unit"),
-                    "device": (v.get("detail") or {}).get("device")
-                    if isinstance(v.get("detail"), (dict, type(None)))
-                    else None,
+                    "device": _device_of(v),
                 }
                 for k, v in (rows_b or {}).items()
                 if isinstance(v, dict) and "value" in v

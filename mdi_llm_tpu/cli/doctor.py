@@ -1,0 +1,336 @@
+"""`mdi-doctor`: staged backend triage that a wedged libtpu cannot hang.
+
+Since r03 the bench suite's TPU probes have been timing out into CPU
+fallbacks, and nothing in any artifact said WHY: the probe is a single
+subprocess that either answers or doesn't.  This tool decomposes backend
+bring-up into ordered stages — import jax → enumerate devices → tiny
+compiled matmul → donation round-trip → profiler-trace write → one
+collective (when >1 device) — and runs EACH stage in its own subprocess
+under its own hard timeout, so a wedge localizes to a stage instead of
+eating the whole budget, and the tool itself always returns.
+
+The output is a JSON health snapshot: toolchain versions (read via
+importlib.metadata, no jax import in the parent — a hosed install must
+not take the doctor down), platform/hostname, the probe-relevant
+environment (`JAX_PLATFORMS`, `TPU_*`, `XLA_*`, ...), and per-stage
+status/elapsed/error/detail.  Bench embeds the cheap half of this
+snapshot (`provenance()`) in every suite artifact, and `bench --doctor`
+runs the full `--quick` staged triage as a preflight — so the next
+r03-style wedge is diagnosable from the artifact alone
+(docs/observability.md "Device-side observability").
+
+Exit status: 0 when every stage is ok/skipped, 1 otherwise.
+
+Examples::
+
+    mdi-doctor                 # full triage, JSON line on stdout
+    mdi-doctor --quick         # import/devices/matmul only
+    mdi-doctor --json out.json # also write a pretty snapshot file
+    mdi-doctor --device cpu    # pin the stages to the CPU backend
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# Environment keys that decide which backend comes up (and how): captured
+# verbatim into the snapshot so two artifacts can be diffed.  Values are
+# truncated, never redacted — these are platform knobs, not secrets.
+_ENV_PREFIXES = ("JAX_", "TPU_", "LIBTPU", "XLA_", "PJRT_")
+_ENV_VALUE_CAP = 200
+
+# Each stage is a self-contained python snippet run as `python -c` in a
+# FRESH interpreter: stage N's wedge cannot poison stage N+1's process,
+# and the parent enforces the timeout with a kill.  A stage prints ONE
+# JSON line on stdout (its `detail`); a `skipped` key marks a stage that
+# chose not to run (e.g. the collective on a single device).
+STAGES: List[Dict[str, Any]] = [
+    {
+        "name": "import_jax",
+        "help": "import jax/jaxlib and report their versions",
+        "timeout": 120.0,
+        "quick": True,
+        "code": (
+            "import json, time\n"
+            "t0 = time.perf_counter()\n"
+            "import jax, jaxlib\n"
+            "print(json.dumps({'jax': jax.__version__,"
+            " 'jaxlib': jaxlib.__version__,"
+            " 'import_s': round(time.perf_counter() - t0, 3)}))\n"
+        ),
+    },
+    {
+        "name": "devices",
+        "help": "bring up the backend and enumerate devices",
+        "timeout": 180.0,
+        "quick": True,
+        "code": (
+            "import json, jax\n"
+            "ds = jax.devices()\n"
+            "print(json.dumps({'platform': jax.default_backend(),"
+            " 'device_count': len(ds),"
+            " 'device_kind': ds[0].device_kind,"
+            " 'devices': [str(d) for d in ds[:8]]}))\n"
+        ),
+    },
+    {
+        "name": "matmul",
+        "help": "compile and run one tiny matmul",
+        "timeout": 180.0,
+        "quick": True,
+        "code": (
+            "import json, time, jax, jax.numpy as jnp\n"
+            "t0 = time.perf_counter()\n"
+            "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+            "y = (x @ x).block_until_ready()\n"
+            "print(json.dumps({'matmul_s':"
+            " round(time.perf_counter() - t0, 3),"
+            " 'correct': bool(float(y[0, 0]) == 128.0)}))\n"
+        ),
+    },
+    {
+        "name": "donation",
+        "help": "donated-buffer round-trip (the serving engine's idiom)",
+        "timeout": 120.0,
+        "quick": False,
+        "code": (
+            "import json, jax, jax.numpy as jnp\n"
+            "f = jax.jit(lambda a: a + 1, donate_argnums=(0,))\n"
+            "x = jax.device_put(jnp.zeros((256, 256), jnp.float32))\n"
+            "y = f(x).block_until_ready()\n"
+            "print(json.dumps({'donated': bool(x.is_deleted()),"
+            " 'correct': bool(float(y[0, 0]) == 1.0)}))\n"
+        ),
+    },
+    {
+        "name": "profiler_trace",
+        "help": "write a jax.profiler trace (the --profile/--xprof path)",
+        "timeout": 120.0,
+        "quick": False,
+        "code": (
+            "import json, os, tempfile, jax, jax.numpy as jnp\n"
+            "d = tempfile.mkdtemp(prefix='mdi_doctor_xprof_')\n"
+            "with jax.profiler.trace(d):\n"
+            "    (jnp.ones((64, 64)) @ jnp.ones((64, 64)))"
+            ".block_until_ready()\n"
+            "files = [f for r, _, fs in os.walk(d) for f in fs]\n"
+            "print(json.dumps({'n_files': len(files),"
+            " 'wrote_xplane': any(f.endswith('.xplane.pb')"
+            " for f in files)}))\n"
+        ),
+    },
+    {
+        "name": "collective",
+        "help": "one psum across all devices (skipped on 1 device)",
+        "timeout": 180.0,
+        "quick": False,
+        "code": (
+            "import json, jax, jax.numpy as jnp\n"
+            "n = jax.device_count()\n"
+            "if n < 2:\n"
+            "    print(json.dumps({'skipped': 'single device'}))\n"
+            "else:\n"
+            "    out = jax.pmap(lambda x: jax.lax.psum(x, 'i'),"
+            " axis_name='i')(jnp.ones((n,)))\n"
+            "    print(json.dumps({'devices': n,"
+            " 'psum_correct': bool(float(out[0]) == n)}))\n"
+        ),
+    },
+]
+
+
+def _package_versions() -> Dict[str, Optional[str]]:
+    """Toolchain versions WITHOUT importing anything heavy: a wedged or
+    half-installed jax must not prevent the snapshot from recording what
+    is installed (the import itself is stage 1's job)."""
+    from importlib import metadata
+
+    out: Dict[str, Optional[str]] = {}
+    for pkg in ("jax", "jaxlib", "numpy"):
+        try:
+            out[pkg] = metadata.version(pkg)
+        except Exception:
+            out[pkg] = None
+    out["libtpu"] = None
+    for pkg in ("libtpu", "libtpu-nightly"):
+        try:
+            out["libtpu"] = metadata.version(pkg)
+            break
+        except Exception:
+            continue
+    return out
+
+
+def _probe_env() -> Dict[str, str]:
+    return {
+        k: (v if len(v) <= _ENV_VALUE_CAP else v[:_ENV_VALUE_CAP] + "…")
+        for k, v in sorted(os.environ.items())
+        if k.startswith(_ENV_PREFIXES)
+    }
+
+
+def provenance() -> Dict[str, Any]:
+    """The cheap, always-safe environment record (no subprocess, no jax):
+    versions + host + probe-relevant env.  Bench embeds this in EVERY
+    suite artifact as `detail.provenance` so trajectory JSONs are
+    comparable across environments; `collect_snapshot` extends it with
+    staged probe results."""
+    return {
+        "schema": SCHEMA_VERSION,
+        "hostname": socket.gethostname(),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "versions": _package_versions(),
+        "env": _probe_env(),
+    }
+
+
+def run_stage(stage: Dict[str, Any], timeout: Optional[float] = None,
+              env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Run one stage in a fresh interpreter under a hard timeout.  Returns
+    {"name", "status": ok|failed|timeout|skipped, "elapsed_s", "timeout_s",
+    "error", "detail"} — the record shape the snapshot schema pins."""
+    budget = float(timeout if timeout is not None else stage["timeout"])
+    rec: Dict[str, Any] = {
+        "name": stage["name"],
+        "status": "failed",
+        "elapsed_s": 0.0,
+        "timeout_s": budget,
+        "error": None,
+        "detail": {},
+    }
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", stage["code"]],
+            capture_output=True, text=True, timeout=budget,
+            env={**os.environ, **(env or {})},
+        )
+    except subprocess.TimeoutExpired:
+        rec["elapsed_s"] = round(time.perf_counter() - t0, 3)
+        rec["status"] = "timeout"
+        rec["error"] = f"no answer within {budget:g}s (process killed)"
+        return rec
+    except Exception as exc:  # spawn failure: still a record, never a raise
+        rec["elapsed_s"] = round(time.perf_counter() - t0, 3)
+        rec["error"] = f"{type(exc).__name__}: {exc}"
+        return rec
+    rec["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+        rec["error"] = " | ".join(tail) or f"exit code {proc.returncode}"
+        return rec
+    payload: Dict[str, Any] = {}
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                payload = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+            break
+    rec["detail"] = payload
+    rec["status"] = "skipped" if "skipped" in payload else "ok"
+    return rec
+
+
+def collect_snapshot(quick: bool = False,
+                     stage_timeout: Optional[float] = None,
+                     stages: Optional[List[Dict[str, Any]]] = None,
+                     env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Provenance + staged probe results.  `quick` keeps the first three
+    stages (import/devices/matmul — the is-the-backend-alive question);
+    `stage_timeout` overrides every stage's own budget; `stages` swaps in
+    a custom stage list (tests inject a wedged stage to pin the timeout
+    machinery).  `ok` is True iff every stage ended ok/skipped."""
+    chosen = stages if stages is not None else [
+        s for s in STAGES if not quick or s.get("quick")
+    ]
+    records = [run_stage(s, timeout=stage_timeout, env=env) for s in chosen]
+    snap = provenance()
+    snap["quick"] = bool(quick)
+    snap["stages"] = records
+    snap["ok"] = all(r["status"] in ("ok", "skipped") for r in records)
+    for r in records:  # surface the device identity at the top level
+        d = r.get("detail") or {}
+        if "device_kind" in d:
+            snap["backend"] = d.get("platform")
+            snap["device_kind"] = d.get("device_kind")
+            snap["device_count"] = d.get("device_count")
+            break
+    return snap
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mdi-doctor",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--quick", action="store_true",
+                    help="run only the bring-up stages (import_jax, "
+                    "devices, matmul) — the bench --doctor preflight")
+    ap.add_argument("--stage-timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="override every stage's own hard timeout "
+                    "(defaults are per stage, 120-180 s)")
+    ap.add_argument("--json", type=str, default=None, metavar="PATH",
+                    help="also write the snapshot as pretty JSON to PATH "
+                    "(stdout always carries the one-line snapshot)")
+    ap.add_argument("--device", default=None, metavar="PLATFORM",
+                    help="pin the stage subprocesses to a jax platform "
+                    "(sets JAX_PLATFORMS for them, e.g. cpu)")
+    ap.add_argument("--list-stages", action="store_true",
+                    help="print the stage list and exit")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_stages:
+        for s in STAGES:
+            tag = " [quick]" if s.get("quick") else ""
+            print(f"{s['name']:<16} {s['timeout']:>5.0f}s{tag}  {s['help']}")
+        return 0
+    env = {"JAX_PLATFORMS": args.device} if args.device else None
+    snap = collect_snapshot(
+        quick=args.quick, stage_timeout=args.stage_timeout, env=env
+    )
+    for r in snap["stages"]:
+        mark = {"ok": "ok ", "skipped": "-- ", "timeout": "T/O",
+                "failed": "ERR"}[r["status"]]
+        line = f"mdi-doctor: [{mark}] {r['name']:<16} {r['elapsed_s']:.1f}s"
+        if r["error"]:
+            line += f"  {r['error']}"
+        print(line, file=sys.stderr)
+    v = snap["versions"]
+    print(
+        f"mdi-doctor: jax={v.get('jax')} jaxlib={v.get('jaxlib')} "
+        f"libtpu={v.get('libtpu')} backend={snap.get('backend')} "
+        f"device_kind={snap.get('device_kind')} "
+        f"-> {'HEALTHY' if snap['ok'] else 'UNHEALTHY'}",
+        file=sys.stderr,
+    )
+    print(json.dumps(snap), flush=True)
+    if args.json:
+        from pathlib import Path
+
+        p = Path(args.json)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(snap, indent=2) + "\n")
+        print(f"mdi-doctor: snapshot -> {p}", file=sys.stderr)
+    return 0 if snap["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,26 @@ def build_parser():
                     "seconds (default 0.5 when given bare), at host-sync "
                     "boundaries only — the in-process successor to the "
                     "standalone mem_monitor wrapper")
+    # device-side observability (docs/observability.md "Device-side"):
+    # XLA executable introspection is ALWAYS on (one side-band AOT
+    # compile per executable at warmup, zero device work); the xprof
+    # flags add a bounded deep-profile window
+    ap.add_argument("--no-device-obs", action="store_true",
+                    help="skip the XLA executable introspection "
+                    "(cost/memory analysis per serving executable) and "
+                    "the MFU/MBU roofline block in the stats line")
+    ap.add_argument("--xprof-steps", type=int, default=None, metavar="N",
+                    help="wrap N mid-run engine steps (after --xprof-skip "
+                    "warm steps) in a jax.profiler trace, so a "
+                    "production-length replay yields a BOUNDED xplane "
+                    "capture (utils/profiling.StepWindowProfiler)")
+    ap.add_argument("--xprof-dir", type=Path, default=Path("logs/xprof"),
+                    metavar="DIR",
+                    help="where --xprof-steps writes the trace "
+                    "(open with tensorboard --logdir or Perfetto)")
+    ap.add_argument("--xprof-skip", type=int, default=8,
+                    help="engine steps to let pass before the --xprof-steps "
+                    "window opens (past warmup compiles, into steady state)")
     return ap
 
 
@@ -243,7 +263,8 @@ def main(argv=None):
     from mdi_llm_tpu.obs import ServingObserver
 
     obs = ServingObserver(ring=args.trace_ring,
-                          rss_interval_s=args.sample_rss)
+                          rss_interval_s=args.sample_rss,
+                          device=not args.no_device_obs)
     # the audited config IS the engine config — no second hand-kept copy
     engine = gen.serve(serving=serving_cfg, obs=obs)
 
@@ -270,7 +291,30 @@ def main(argv=None):
 
     for rid, prompt, new in trace:
         engine.add_request(rid, prompt, new)
-    results, stats = engine.run()
+    # --xprof-steps: a bounded deep-profile window over N mid-run steps —
+    # NOT the whole run, so replay length never bloats the capture
+    xprof = None
+    if args.xprof_steps:
+        from mdi_llm_tpu.utils.profiling import StepWindowProfiler
+
+        args.xprof_dir.mkdir(parents=True, exist_ok=True)
+        xprof = StepWindowProfiler(
+            args.xprof_dir, args.xprof_steps, skip=args.xprof_skip
+        )
+    try:
+        results, stats = engine.run(
+            step_hook=xprof.on_step if xprof is not None else None
+        )
+    finally:
+        if xprof is not None:
+            xprof.close()  # short runs / exceptions: never leak a trace
+    if xprof is not None and xprof.window is not None:
+        print(
+            f"mdi-serve: xprof window steps {xprof.window[0]}-"
+            f"{xprof.window[1]} -> {args.xprof_dir} "
+            "(tensorboard --logdir, or load in Perfetto)",
+            file=sys.stderr,
+        )
 
     for rid, prompt, _new in trace:
         out = results.get(rid, [])
@@ -295,6 +339,39 @@ def main(argv=None):
             for name, summ in obs.latency_summaries().items()
         },
     })
+    if not args.no_device_obs:
+        # achieved MFU/MBU against the running chip's peak (null off the
+        # peak table, e.g. CPU) — docs/observability.md "Device-side";
+        # the full per-executable cost sheets land in --metrics-out
+        import jax
+
+        from mdi_llm_tpu.obs import roofline as rf
+
+        kind = getattr(jax.devices()[0], "device_kind", None)
+        ctxs = [
+            len(p) + max(0, len(results.get(rid, [])) - len(p)) / 2
+            for rid, p, _new in trace
+        ]
+        ctx_mean = int(sum(ctxs) / max(1, len(ctxs)))
+        eff_batch = (
+            max(1, round(stats.mixed_batch_occupancy * args.max_batch))
+            if stats.mixed_batch_occupancy else args.max_batch
+        )
+        roof = rf.serving_roofline(
+            cfg, serving_cfg, tokens_per_s=stats.tokens_per_s,
+            context=ctx_mean, batch=eff_batch,
+            weight_bytes=rf.param_bytes(gen.params),
+            device_kind=kind, n_chips=max(1, args.tp), dtype=args.dtype,
+        )
+        line["device"] = {
+            "kind": kind,
+            "mfu": None if roof["mfu"] is None else round(roof["mfu"], 6),
+            "mbu": None if roof["mbu"] is None else round(roof["mbu"], 6),
+            "achieved_tflops_per_s": round(roof["achieved_tflops_per_s"], 4),
+            "achieved_hbm_gbps": round(roof["achieved_hbm_gbps"], 4),
+            "context_mean": ctx_mean,
+            "executables": len(obs.device),
+        }
     print(json.dumps(line), file=sys.stderr)
 
     if args.metrics_out:

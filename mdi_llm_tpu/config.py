@@ -186,6 +186,13 @@ class Config:
         norms = 2 * D * (2 if self.bias and self.norm_class_name == "LayerNorm" else 1)
         return emb + head + L * (attn + mlp + norms) + D
 
+    def estimate_param_bytes(self, dtype="bfloat16") -> int:
+        """HBM bytes of the parameter tree stored at `dtype` — the
+        backend-free analytic twin of `obs.roofline.param_bytes` (which
+        measures a LIVE tree, quantized storage included).  Used by the
+        roofline/docs tables when no weights exist yet."""
+        return self.estimate_params() * dtype_bytes(dtype)
+
     def estimate_kv_bytes(
         self, batch: int, seq: int, dtype="bfloat16", n_layer: Optional[int] = None
     ) -> int:

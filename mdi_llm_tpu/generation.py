@@ -412,6 +412,32 @@ class Generator:
         # trace): a bench warmup engine and its timed twin must reuse ONE
         # jit cache or the timed run re-traces every shape it warmed
         self._serve_fns: Dict[Any, Dict[Any, Any]] = {}
+        # XLA ExecutableReports (obs/device.py), keyed (label, shape-key,
+        # pool dtype) and shared across engines for the same reason the jit
+        # cache is: AOT introspection happens once per executable per
+        # Generator — during warmup — so a device-obs timed run never
+        # lowers anything post-warm (the CompileGuard contract)
+        self._exec_reports: Dict[Any, Any] = {}
+        # sequential-path device introspection: attach_device_obs() sets a
+        # DeviceReportRegistry and generate()'s prefill/decode-chunk
+        # dispatches capture their cost sheets into it
+        self.device_obs = None
+
+    def attach_device_obs(self, registry) -> None:
+        """Attach an `obs.device.DeviceReportRegistry`: subsequent
+        `generate()` calls capture each compiled phase's XLA cost sheet
+        (`ExecutableReport`, one AOT lower+compile per (path, shape) —
+        side-band, zero device work, the jit cache untouched).  Pass None
+        to detach.  The serving engine has its own hook via
+        `ServingObserver(device=True)`; this one serves the sequential
+        paths (docs/observability.md "Device-side observability")."""
+        self.device_obs = registry
+
+    def _dev_capture(self, label, key, fn, args, static_kwargs=None) -> None:
+        """Capture-once hook on the sequential dispatch sites: a dict
+        lookup when the report exists, one AOT introspection when not."""
+        if self.device_obs is not None and self.device_obs.capture_enabled:
+            self.device_obs.capture(label, key, fn, args, static_kwargs)
 
     def _place_kv(self, kv):
         """Lay a fresh KV cache over the inference mesh (no-op without one)."""
@@ -679,7 +705,12 @@ class Generator:
             kv = self._place_kv(
                 transformer.init_kv_cache(self.cfg, B, cache_len, dtype=self.cache_dtype)
             )
-            last_logits, kv = self._prefill_fn(B, Tb)(
+            pf = self._prefill_fn(B, Tb)
+            self._dev_capture(
+                "prefill", (B, Tb), pf,
+                (self.params, batch, kv, np.asarray(lens, np.int32)),
+            )
+            last_logits, kv = pf(
                 self.params, jnp.asarray(batch), kv, jnp.asarray(lens, jnp.int32)
             )
         # first sampled token (from prefill logits)
@@ -821,7 +852,16 @@ class Generator:
                 k = min(chunk_size, max_new_tokens - n, room)
                 if k < 1:
                     break
-                toks_j, kv, self.key = self._decode_chunk_fn(len(lanes), k)(
+                dc = self._decode_chunk_fn(len(lanes), k)
+                # tok/positions are host ndarrays here: the capture reads
+                # shapes only, no device value is touched
+                self._dev_capture(
+                    "decode_chunk", (len(lanes), k), dc,
+                    (self.params, tok.astype(np.int32), kv,
+                     positions, self.key, t_op, p_op),
+                    {"mode": mode, "top_k": top_k},
+                )
+                toks_j, kv, self.key = dc(
                     self.params,
                     jnp.asarray(tok, jnp.int32),
                     kv,

@@ -14,7 +14,7 @@ already own:
     json.dump(obs.metrics_dict(stats), open("metrics.json", "w"))
     obs.tracer.write_chrome_trace("trace.json")     # open in Perfetto
 
-It bundles three parts (docs/observability.md):
+It bundles four parts (docs/observability.md):
 
 - `obs.metrics`  — `MetricsRegistry`: counters/gauges/fixed-bucket
   histograms with JSON + Prometheus exposition (`obs/metrics.py`);
@@ -23,7 +23,13 @@ It bundles three parts (docs/observability.md):
   (`obs/tracing.py`);
 - latency derivation — per-request TTFT/TPOT/E2E/queue-wait over the
   completed-request window, aggregated to p50/p95/p99
-  (`latency_summary`).
+  (`latency_summary`);
+- `obs.device`   — `DeviceReportRegistry`: XLA executable introspection
+  (`obs/device.py` `ExecutableReport`: cost_analysis FLOPs/bytes +
+  memory_analysis temp/argument/output bytes per serving executable).
+  Pass `device=True` to CAPTURE (one side-band AOT compile per
+  executable, during warmup); the default observer still RECEIVES
+  reports captured earlier on the same Generator, for free.
 
 Overhead contract (pinned by tests/test_obs.py): every hook is a plain
 host-side append — enabling the observer adds ZERO extra host syncs,
@@ -40,6 +46,7 @@ import os
 import time
 from typing import Callable, Dict, Optional
 
+from mdi_llm_tpu.obs.device import DeviceReportRegistry, ExecutableReport
 from mdi_llm_tpu.obs.metrics import (
     LATENCY_BUCKETS_S,
     Counter,
@@ -53,6 +60,8 @@ from mdi_llm_tpu.obs.tracing import RequestTiming, TraceRecorder
 
 __all__ = [
     "Counter",
+    "DeviceReportRegistry",
+    "ExecutableReport",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
@@ -74,14 +83,20 @@ class ServingObserver:
     trace-event and completed-request windows; `rss_interval_s` (None =
     off) samples the host process tree's RSS via
     `cli.mem_monitor.sample_rss` at most once per interval, at sync
-    boundaries only (`mdi-serve --sample-rss`).
+    boundaries only (`mdi-serve --sample-rss`).  `device=True` enables
+    XLA executable CAPTURE (`obs/device.py`): the engine AOT-introspects
+    each executable once, at warmup, caching reports on its Generator —
+    the default (False) observer never triggers a capture but still
+    receives reports already cached there.
     """
 
     def __init__(self, ring: int = 65536,
                  clock: Callable[[], float] = time.perf_counter,
-                 rss_interval_s: Optional[float] = None):
+                 rss_interval_s: Optional[float] = None,
+                 device: bool = False):
         self.clock = clock
         self.metrics = MetricsRegistry()
+        self.device = DeviceReportRegistry(capture_enabled=device)
         self.tracer = TraceRecorder(capacity=ring, clock=clock)
         self.rss_interval_s = rss_interval_s
         self._last_rss_ts: Optional[float] = None
@@ -243,6 +258,27 @@ class ServingObserver:
         profiling.remove_compile_listener(self._compile_hook)
         self._compile_hook = None
 
+    # -- device-side introspection (obs/device.py) ---------------------------
+
+    def publish_device_report(self, report) -> None:
+        """Register an `ExecutableReport` and mirror its headline numbers
+        into the metrics registry (`xla_<label>_{flops,bytes_accessed,
+        temp_bytes}` gauges — one per dispatch path; the full per-shape
+        fidelity lives in `metrics_dict()["device"]`).  Publishing is a
+        host-side append: it never lowers, compiles or syncs anything."""
+        self.device.add(report)
+        for suffix, value in (
+            ("flops", report.flops),
+            ("bytes_accessed", report.bytes_accessed),
+            ("temp_bytes", report.temp_bytes),
+        ):
+            if value is not None:
+                self.metrics.gauge(
+                    f"xla_{report.label}_{suffix}",
+                    f"XLA {suffix.replace('_', ' ')} of the {report.label} "
+                    "executable (cost/memory_analysis)",
+                ).set(value)
+
     # -- exposition ----------------------------------------------------------
 
     def latency_summaries(self) -> Dict[str, Dict[str, float]]:
@@ -265,6 +301,8 @@ class ServingObserver:
                      "events_dropped": self.tracer.dropped,
                      "completed_window": len(self.tracer.completed)},
         }
+        if len(self.device):
+            out["device"] = self.device.to_dict()
         if stats is not None:
             out["serving_stats"] = stats.to_dict()
         return out

@@ -1,0 +1,216 @@
+"""XLA executable introspection: what each serving dispatch costs ON PAPER.
+
+PR 7 gave serving host-side eyes (request tracing, latency percentiles);
+this module looks below the host-sync boundary WITHOUT adding any device
+work: for a jitted function and the shapes it dispatches at, an AOT
+`fn.lower(*abstract_args).compile()` yields the XLA compiler's own
+`cost_analysis()` (FLOPs, bytes accessed, transcendentals) and
+`memory_analysis()` (argument/output/temp/generated-code bytes) for the
+EXACT executable the engine runs — captured as an `ExecutableReport`.
+
+Contract (the device-side half of the PR 7 overhead contract, pinned by
+tests/test_device_obs.py):
+
+- **Zero device work.**  Arguments are abstracted to `ShapeDtypeStruct`s
+  (shapes + dtypes + shardings, no buffers), so capture never transfers,
+  executes or syncs anything.
+- **Zero effect on the jit cache.**  AOT lowering is side-band: the jitted
+  function's own dispatch cache is neither read nor written, so the
+  engine's executables, donation behaviour and CompileGuard counters for
+  the REAL dispatches are untouched.  The capture itself does trace and
+  compile (that is where the numbers come from) — which is why callers
+  capture at most ONCE per (label, key, variant) and do it during warmup:
+  the serving engine caches reports on the Generator
+  (`Generator._exec_reports`), so the post-warmup steady state never
+  lowers anything and the zero-post-warmup-recompile contract holds with
+  device observability enabled.
+- **Never raises.**  Backends without the AOT cost APIs (or executables
+  that refuse to lower abstractly) produce a report with `error` set and
+  every number None — observability must not take the engine down.
+
+Reports flow into the PR 7 surfaces: `ServingObserver.device` (a
+`DeviceReportRegistry`), gauges in the `MetricsRegistry`
+(`xla_<label>_flops` etc.), the `--metrics-out` JSON and the
+`detail.device` block of bench serve rows (docs/observability.md
+"Device-side observability").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ExecutableReport",
+    "DeviceReportRegistry",
+    "abstractify",
+    "introspect",
+]
+
+
+def abstractify(tree):
+    """Map every array leaf of an argument pytree to a
+    `jax.ShapeDtypeStruct` carrying its shape, dtype and (for committed
+    jax arrays) sharding — the abstract signature `jax.jit(...).lower`
+    accepts in place of real buffers.  Shardings matter under a tp mesh:
+    without them the AOT compile would build (and cost) the UNSHARDED
+    program, not the one the engine runs."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        shape = jnp.shape(x)
+        dtype = getattr(x, "dtype", None)
+        if dtype is None:  # python scalar leaf (engine args never are, but
+            dtype = jnp.result_type(x)  # stay total for external callers)
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None:
+            try:
+                return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+            except TypeError:  # older ShapeDtypeStruct without sharding kwarg
+                pass
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+@dataclass
+class ExecutableReport:
+    """One compiled executable's static cost sheet.
+
+    `flops`/`bytes_accessed`/`transcendentals` come from
+    `compiled.cost_analysis()` (the XLA HLO cost model — counted over the
+    optimized program, so fusion/DCE effects are included);
+    `*_bytes` from `compiled.memory_analysis()`.  `None` means the
+    backend did not report that number (`error` says why when the whole
+    capture failed)."""
+
+    label: str  # dispatch path: mixed / decode / decode_chunk / verify / ...
+    key: Tuple  # static-shape key, e.g. (B, T)
+    variant: str = ""  # e.g. the pool kv dtype — same shapes, different HLO
+    backend: str = ""
+    flops: Optional[float] = None
+    transcendentals: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    error: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        """Stable human/JSON key: `label(k0,k1)[variant]`."""
+        ks = ",".join(str(k) for k in self.key)
+        tag = f"[{self.variant}]" if self.variant else ""
+        return f"{self.label}({ks}){tag}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "label": self.label,
+            "key": list(self.key),
+            "variant": self.variant,
+            "backend": self.backend,
+            "flops": self.flops,
+            "transcendentals": self.transcendentals,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "error": self.error,
+        }
+
+
+def _first_module(analysis):
+    """`cost_analysis()` returns a dict on recent jax and a one-per-module
+    list on older releases; normalize to one dict (multi-module programs
+    put the entry computation first)."""
+    if isinstance(analysis, (list, tuple)):
+        return analysis[0] if analysis else {}
+    return analysis or {}
+
+
+def introspect(fn, args, static_kwargs=None, label="", key=(),
+               variant="") -> ExecutableReport:
+    """AOT-compile `fn` at `args`' shapes and read the compiler's cost and
+    memory analyses into an `ExecutableReport`.  `fn` must be a
+    `jax.jit`-wrapped callable; `args` the positional arguments of one
+    real dispatch (arrays or numpy arrays — only shapes/dtypes/shardings
+    are read); `static_kwargs` the static keyword arguments.  Never
+    raises: failures come back as a report with `error` set."""
+    import jax
+
+    rep = ExecutableReport(label=label, key=tuple(key), variant=variant,
+                           backend=jax.default_backend())
+    try:
+        compiled = fn.lower(*abstractify(args), **(static_kwargs or {})).compile()
+    except Exception as exc:  # refused abstract lowering, AOT API missing…
+        rep.error = f"{type(exc).__name__}: {exc}"
+        return rep
+    try:
+        cost = _first_module(compiled.cost_analysis())
+        rep.flops = cost.get("flops")
+        rep.transcendentals = cost.get("transcendentals")
+        rep.bytes_accessed = cost.get("bytes accessed")
+    except Exception as exc:  # pragma: no cover - backend-dependent API
+        rep.error = f"cost_analysis: {type(exc).__name__}: {exc}"
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            rep.argument_bytes = int(mem.argument_size_in_bytes)
+            rep.output_bytes = int(mem.output_size_in_bytes)
+            rep.temp_bytes = int(mem.temp_size_in_bytes)
+            rep.alias_bytes = int(mem.alias_size_in_bytes)
+            rep.generated_code_bytes = int(mem.generated_code_size_in_bytes)
+    except Exception as exc:  # pragma: no cover - backend-dependent API
+        err = f"memory_analysis: {type(exc).__name__}: {exc}"
+        rep.error = f"{rep.error}; {err}" if rep.error else err
+    return rep
+
+
+class DeviceReportRegistry:
+    """Report store keyed on (label, key, variant), one capture each.
+
+    `capture_enabled=False` builds a publish-only registry: `capture`
+    becomes a no-op (no AOT compiles ever), but `add` still accepts
+    reports captured elsewhere — how a fresh observer on a warm Generator
+    gets the warmup-time reports without compiling anything
+    (`ServingEngine` publishes its Generator's cache at run end)."""
+
+    def __init__(self, capture_enabled: bool = True):
+        self.capture_enabled = capture_enabled
+        self._reports: "Dict[Tuple, ExecutableReport]" = {}
+
+    def __len__(self) -> int:
+        return len(self._reports)
+
+    def capture(self, label, key, fn, args, static_kwargs=None,
+                variant="") -> Optional[ExecutableReport]:
+        k = (label, tuple(key), variant)
+        if not self.capture_enabled:
+            return self._reports.get(k)
+        if k not in self._reports:
+            self._reports[k] = introspect(
+                fn, args, static_kwargs, label=label, key=key, variant=variant
+            )
+        return self._reports[k]
+
+    def add(self, report: ExecutableReport) -> None:
+        """Publish an externally-captured report (first one wins)."""
+        self._reports.setdefault(
+            (report.label, report.key, report.variant), report
+        )
+
+    def get(self, label, key, variant="") -> Optional[ExecutableReport]:
+        return self._reports.get((label, tuple(key), variant))
+
+    def reports(self) -> List[ExecutableReport]:
+        return list(self._reports.values())
+
+    def to_dict(self) -> Dict[str, Dict]:
+        """{report.name: report dict}, insertion-ordered — the
+        `detail.device.executables` / `--metrics-out` "device" block."""
+        return {r.name: r.to_dict() for r in self._reports.values()}

@@ -1,0 +1,256 @@
+"""Serving roofline accounting: analytic FLOPs/bytes per token, device
+peaks, and achieved MFU/MBU.
+
+Training has had an MFU number since the first bench round
+(`training.estimate_flops_per_token` + a peak constant); serving rows
+reported bare tokens/s — a number that cannot be compared across chips
+or against the Ragged Paged Attention paper's roofline-stated wins.
+This module is the inference complement:
+
+- analytic per-token decode/prefill FLOPs from `Config` (forward-only:
+  the 2·params matmul term minus the gather-only embedding, plus the
+  4·L·H·hs·S attention term — exactly one third of the training
+  estimate's 6N + 12·L·H·hs·T split);
+- analytic HBM bytes per decode token, `kv_dtype`/`block_bytes`-aware:
+  the int8 paged pool gets credit for its smaller blocks (scale side
+  arrays included) because the byte model routes through
+  `ServingConfig.block_bytes` — THE per-block formula the engine
+  allocates by, so the roofline can never disagree with the audit;
+- a device-peak table keyed on `jax.Device.device_kind`
+  (v4/v5e/v5p/v6e; unknown kinds — CPU, GPU, new TPUs — map to None and
+  every derived utilization reports null rather than a lie);
+- achieved MFU/MBU from measured tokens/s.
+
+The analytic FLOPs model is cross-checked against the XLA compiler's own
+`cost_analysis` (`obs/device.py`) within `XLA_AGREEMENT_RTOL` — pinned
+by tests/test_roofline.py on the CPU backend, so the hand model can
+never silently rot away from what the executables actually compute.
+
+Peak sources (public spec sheets; dense bf16, no sparsity):
+v4 275 TFLOP/s / 1228 GB/s · v5e 197 / 819 · v5p 459 / 2765 ·
+v6e (Trillium) 918 / 1640.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from mdi_llm_tpu.config import Config, ServingConfig, dtype_bytes
+
+__all__ = [
+    "DEVICE_PEAKS",
+    "XLA_AGREEMENT_RTOL",
+    "device_peaks",
+    "decode_flops_per_token",
+    "prefill_flops_per_token",
+    "decode_hbm_bytes_per_token",
+    "param_bytes",
+    "serving_roofline",
+    "crosscheck_flops",
+]
+
+# Dense bf16 peak compute and HBM bandwidth per chip, by TPU generation.
+# bench's training MFU and the serving MFU/MBU derivation both read THIS
+# table (the pre-PR-10 train row hardcoded the v5e number whatever chip
+# actually ran).
+DEVICE_PEAKS: Dict[str, Dict[str, float]] = {
+    "v4": {"bf16_tflops": 275.0, "hbm_gbps": 1228.0},
+    "v5e": {"bf16_tflops": 197.0, "hbm_gbps": 819.0},
+    "v5p": {"bf16_tflops": 459.0, "hbm_gbps": 2765.0},
+    "v6e": {"bf16_tflops": 918.0, "hbm_gbps": 1640.0},
+}
+
+# The train row's historical reference chip: when the device kind is
+# unknown (CPU fallback, new hardware) bench still reports an MFU against
+# this peak, clearly labelled "assumed" — a comparable number beats null
+# for the flagship training row, while SERVING utilization stays null on
+# unknown kinds (it is a hardware claim, not a trend line).
+ASSUMED_TRAIN_PEAK_KIND = "v5e"
+
+# Analytic-vs-XLA agreement bound for the FLOPs model (crosscheck_flops,
+# pinned by tests/test_roofline.py).  The analytic model counts matmul +
+# attention terms only; the compiled program adds norms/rope/softmax/
+# sampling and subtracts whatever fusion/DCE eliminates — measured gap on
+# the CPU backend is ~10-15%, pinned at 25% so a real model drift (a
+# forgotten projection, a doubled attention term) fails loudly.
+XLA_AGREEMENT_RTOL = 0.25
+
+
+def device_peaks(device_kind: Optional[str]) -> Optional[Dict[str, float]]:
+    """Map a `jax.Device.device_kind` string to its peak row, or None for
+    kinds the table does not know (CPU, GPU, future TPUs) — callers must
+    treat None as "report null utilization", never assume a chip."""
+    if not device_kind:
+        return None
+    kind = str(device_kind).lower()
+    if "v6" in kind:  # "TPU v6 lite" / "TPU v6e" — only the e variant exists
+        return DEVICE_PEAKS["v6e"]
+    if "v5p" in kind:
+        return DEVICE_PEAKS["v5p"]
+    if "v5e" in kind or "v5 lite" in kind or "v5lite" in kind:
+        return DEVICE_PEAKS["v5e"]
+    if "v5" in kind:  # bare "TPU v5" is how v5p reports itself
+        return DEVICE_PEAKS["v5p"]
+    if "v4" in kind:
+        return DEVICE_PEAKS["v4"]
+    return None
+
+
+def _linear_flops_per_token(cfg: Config) -> float:
+    """Matmul FLOPs per token through the weights: 2 MACs per weight for
+    every LINEAR parameter.  The token embedding is a gather (no FLOPs),
+    so one V·D is subtracted from `estimate_params`; the lm_head matmul
+    always runs — for tied embeddings it reuses the subtracted wte, so
+    the V·D goes back in."""
+    N = cfg.estimate_params()
+    emb = cfg.padded_vocab_size * cfg.n_embd
+    lin = N - emb
+    if cfg.tie_embeddings:
+        lin += emb
+    return 2.0 * lin
+
+
+def decode_flops_per_token(cfg: Config, context: int) -> float:
+    """Forward FLOPs to generate ONE token with `context` KV positions
+    resident: 2·params(linear) + 4·L·H·hs·context (QKᵀ and A·V, 2 FLOPs
+    per MAC each).  The inference third of
+    `training.estimate_flops_per_token`'s 6N + 12·L·H·hs·T."""
+    attn = 4.0 * cfg.n_layer * cfg.n_head * cfg.head_size * int(context)
+    return _linear_flops_per_token(cfg) + attn
+
+
+def prefill_flops_per_token(cfg: Config, prompt_len: int) -> float:
+    """Mean forward FLOPs per PROMPT token: position p attends p+1
+    positions, so the causal average over a T-token prompt is (T+1)/2."""
+    return decode_flops_per_token(cfg, (int(prompt_len) + 1) // 2)
+
+
+def param_bytes(params: Any) -> int:
+    """Exact HBM bytes of a live parameter tree (quantized trees included:
+    int8/int4 storage leaves count at their stored width).  Host-side
+    metadata only — no sync, no transfer."""
+    import math
+
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        n = int(math.prod(getattr(leaf, "shape", ()) or (1,)))
+        total += n * dtype_bytes(leaf.dtype)
+    return total
+
+
+def decode_hbm_bytes_per_token(
+    cfg: Config,
+    serving: Optional[ServingConfig],
+    batch: int,
+    context: int,
+    weight_bytes: int,
+    dtype: str = "bfloat16",
+) -> Dict[str, float]:
+    """Analytic HBM traffic to decode ONE token at batch `batch` with
+    `context` resident KV positions.
+
+    Decode is bandwidth-bound: every step streams all weights once
+    (shared by the whole batch → weight_bytes / batch per token) and
+    reads the sequence's live KV.  With a paged pool the read granularity
+    is whole blocks — ceil(context / block_size) × `ServingConfig.
+    block_bytes` (payload at the POOL dtype plus the int8 scale arrays),
+    which is exactly how int8 pools earn their MBU credit; pass
+    `serving=None` for a dense contiguous cache (2·L·G·hs·context at
+    `dtype`).  The per-token KV write (one position's k+v) rides along;
+    activations never round-trip HBM at decode widths and are ignored.
+    """
+    batch = max(1, int(batch))
+    context = int(context)
+    if serving is not None:
+        bb = serving.block_bytes(cfg, dtype)
+        n_blocks = -(-context // serving.block_size) if context else 0
+        kv_read = float(n_blocks * bb["total_bytes"])
+        kv_write = bb["kv_bytes"] / serving.block_size
+        kv_dtype = bb["kv_dtype"]
+    else:
+        item = dtype_bytes(dtype)
+        kv_read = float(
+            2 * cfg.n_layer * cfg.n_query_groups * cfg.head_size * context * item
+        )
+        kv_write = float(2 * cfg.n_layer * cfg.n_query_groups * cfg.head_size * item)
+        kv_dtype = dtype
+    weights = weight_bytes / batch
+    return {
+        "weight_bytes": weights,
+        "kv_read_bytes": kv_read,
+        "kv_write_bytes": kv_write,
+        "kv_dtype": kv_dtype,
+        "total_bytes": weights + kv_read + kv_write,
+    }
+
+
+def serving_roofline(
+    cfg: Config,
+    serving: Optional[ServingConfig],
+    tokens_per_s: float,
+    context: int,
+    batch: int,
+    weight_bytes: int,
+    device_kind: Optional[str],
+    n_chips: int = 1,
+    dtype: str = "bfloat16",
+) -> Dict[str, Any]:
+    """Achieved MFU/MBU of a serving run: measured `tokens_per_s` (TOTAL
+    across chips) times the analytic per-token FLOPs/bytes at the run's
+    mean `context` and effective `batch`, over `n_chips` × the device
+    peak.  Unknown `device_kind` → `mfu`/`mbu` are None (the peaks row is
+    absent), but the achieved absolute rates still report — a CPU row
+    carries its TFLOP/s even though "utilization of a CPU" is undefined
+    here.  Embedded as `detail.device.roofline` by bench serve rows and
+    the mdi-serve stats line (docs/observability.md)."""
+    peaks = device_peaks(device_kind)
+    flops_tok = decode_flops_per_token(cfg, context)
+    bytes_tok = decode_hbm_bytes_per_token(
+        cfg, serving, batch, context, weight_bytes, dtype=dtype
+    )
+    achieved_flops = tokens_per_s * flops_tok
+    achieved_bytes = tokens_per_s * bytes_tok["total_bytes"]
+    n_chips = max(1, int(n_chips))
+    out: Dict[str, Any] = {
+        "device_kind": device_kind,
+        "peaks": peaks,
+        "n_chips": n_chips,
+        "context_mean": int(context),
+        "batch": int(batch),
+        "flops_per_token": flops_tok,
+        "hbm_bytes_per_token": bytes_tok,
+        "achieved_tflops_per_s": achieved_flops / 1e12,
+        "achieved_hbm_gbps": achieved_bytes / 1e9,
+        "mfu": None,
+        "mbu": None,
+    }
+    if peaks is not None:
+        out["mfu"] = achieved_flops / (n_chips * peaks["bf16_tflops"] * 1e12)
+        out["mbu"] = achieved_bytes / (n_chips * peaks["hbm_gbps"] * 1e9)
+    return out
+
+
+def crosscheck_flops(report, analytic_flops: float,
+                     rtol: float = XLA_AGREEMENT_RTOL) -> Dict[str, Any]:
+    """Compare an `ExecutableReport`'s XLA-counted FLOPs against the
+    analytic model's number for the same dispatch.  Returns the agreement
+    record embedded in `detail.device.crosscheck`; `agrees` is None when
+    the backend reported no FLOPs (nothing to judge), else whether the
+    relative error is within `rtol` — the tripwire that keeps the
+    analytic model honest (tests/test_roofline.py pins it on CPU)."""
+    xla = getattr(report, "flops", None)
+    out: Dict[str, Any] = {
+        "executable": getattr(report, "name", str(report)),
+        "xla_flops": xla,
+        "analytic_flops": float(analytic_flops),
+        "rtol": rtol,
+        "rel_err": None,
+        "agrees": None,
+    }
+    if xla is not None and analytic_flops > 0:
+        rel = abs(xla - analytic_flops) / analytic_flops
+        out["rel_err"] = rel
+        out["agrees"] = rel <= rtol
+    return out

@@ -69,7 +69,11 @@ events, per-step spans and KV/queue gauges into it — exclusively at the
 host-sync boundaries this loop already performs (the one `np.asarray`
 read per dispatch), so tracing adds zero extra syncs, zero device ops and
 zero recompiles; per-request TTFT/TPOT/E2E/queue-wait percentiles and a
-Perfetto-loadable timeline come out the other side.
+Perfetto-loadable timeline come out the other side.  With
+`ServingObserver(device=True)` the engine additionally captures each
+executable's XLA cost sheet (`obs/device.py` ExecutableReport — AOT
+cost/memory analysis over abstract shapes, once per (path, shape, pool
+dtype) per Generator at warmup; zero device work, jit cache untouched).
 """
 
 from __future__ import annotations
@@ -599,6 +603,34 @@ class ServingEngine:
             self._fns[key_] = verify
         return self._fns[key_]
 
+    # -- device-side introspection (obs/device.py) ---------------------------
+
+    def _introspect(self, label, key, fn, args, static_kwargs=None) -> None:
+        """Capture this executable's XLA cost sheet (`ExecutableReport`:
+        cost_analysis FLOPs/bytes + memory_analysis temp/arg/output
+        bytes) ONCE per (path, shape, pool dtype) per Generator, via a
+        side-band AOT `.lower().compile()` over abstract shapes — zero
+        device work, the jit dispatch cache untouched.  Reports cache on
+        `gen._exec_reports` (the same lifetime as the jit cache), so the
+        capture compiles only at warmup — first dispatch of each shape —
+        and the post-warmup steady state never lowers anything: device
+        obs rides the CompileGuard contract (tests/test_device_obs.py).
+        Only runs when the attached observer asked for capture
+        (`ServingObserver(device=True)`)."""
+        obs = self.obs
+        if obs is None or not obs.device.capture_enabled:
+            return
+        cache = self.gen._exec_reports
+        k = (label, key, self.kv_dtype_name)
+        if k not in cache:
+            from mdi_llm_tpu.obs.device import introspect
+
+            cache[k] = introspect(
+                fn, args, static_kwargs,
+                label=label, key=key, variant=self.kv_dtype_name,
+            )
+        obs.publish_device_report(cache[k])
+
     # -- request surface -----------------------------------------------------
 
     def add_request(
@@ -698,10 +730,17 @@ class ServingEngine:
             last_idx[seq.slot] = off + n - 1
             off += n
         tables = self._sync_tables([s for s, _ in live])
+        fn = self._mixed_fn(B, T)
+        self._introspect(
+            "mixed", (B, T), fn,
+            (self.gen.params, tokens, self._kv, tables, pos, q_slot,
+             q_start, q_len, last_idx, self.gen.key, self._t_op, self._p_op),
+            {"mode": self._sample_mode, "top_k": self.cfg.top_k},
+        )
         kv = self._kv
         self._kv = None  # donated
         try:
-            nxt, self._kv, self.gen.key = self._mixed_fn(B, T)(
+            nxt, self._kv, self.gen.key = fn(
                 self.gen.params, jnp.asarray(tokens), kv,
                 jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(q_slot),
                 jnp.asarray(q_start), jnp.asarray(q_len),
@@ -814,10 +853,17 @@ class ServingEngine:
             tok[seq.slot] = seq.next_tok
             pos[seq.slot] = seq.fed
         tables = self._sync_tables(live)
+        fn = self._decode_fn(B)
+        self._introspect(
+            "decode", (B,), fn,
+            (self.gen.params, tok, self._kv, tables, pos, self.gen.key,
+             self._t_op, self._p_op),
+            {"mode": self._sample_mode, "top_k": self.cfg.top_k},
+        )
         kv = self._kv
         self._kv = None  # donated
         try:
-            nxt, self._kv, self.gen.key = self._decode_fn(B)(
+            nxt, self._kv, self.gen.key = fn(
                 self.gen.params, jnp.asarray(tok), kv, jnp.asarray(tables),
                 jnp.asarray(pos), self.gen.key, self._t_op, self._p_op,
                 mode=self._sample_mode, top_k=self.cfg.top_k,
@@ -945,6 +991,12 @@ class ServingEngine:
         tok_d, pos_d = jnp.asarray(tok), jnp.asarray(pos)
         stop_d = jnp.asarray(stop1)
         tables = self._sync_tables(live)
+        self._introspect(
+            "decode_chunk", (B, K), fn,
+            (self.gen.params, tok, self._kv, tables, pos, limits, stop1,
+             self.gen.key, self._t_op, self._p_op),
+            {"mode": self._sample_mode, "top_k": self.cfg.top_k},
+        )
         pending = None  # (limits, sampled tokens still on device)
         while True:
             kv = self._kv
@@ -1042,10 +1094,15 @@ class ServingEngine:
             toks_in[seq.slot] = row
             pos[seq.slot] = seq.fed
         tables = self._sync_tables(live)
+        fn = self._verify_fn(B, K + 1)
+        self._introspect(
+            "verify", (B, K + 1), fn,
+            (self.gen.params, toks_in, self._kv, tables, pos),
+        )
         kv = self._kv
         self._kv = None  # donated
         try:
-            g, self._kv = self._verify_fn(B, K + 1)(
+            g, self._kv = fn(
                 self.gen.params, jnp.asarray(toks_in), kv,
                 jnp.asarray(tables), jnp.asarray(pos),
             )
@@ -1102,21 +1159,29 @@ class ServingEngine:
             self._run_decode(action[1])
         return True
 
-    def run(self, stream_cb=None) -> Tuple[Dict[str, List[int]], ServingStats]:
+    def run(self, stream_cb=None,
+            step_hook=None) -> Tuple[Dict[str, List[int]], ServingStats]:
         """Drive the loop until every queued request finishes.  Returns
         {rid: full token list (prompt + generation, stop-trimmed)} — the
         same shape `Generator.generate` returns per prompt — and stats.
 
         `stream_cb(rid, token)` fires per generated token when given.
+        `step_hook(i)` fires after the i-th engine step (1-based) —
+        `mdi-serve --xprof-steps` hangs its bounded profiler window off
+        this (utils/profiling.StepWindowProfiler).
         """
         self._stream_cb = stream_cb
         t0 = time.perf_counter()
+        n_steps = 0
         if self.obs is not None:
             self.obs.attach_compile_hook()
         try:
             while self.scheduler.has_work:
                 if not self.step():
                     break
+                if step_hook is not None:
+                    n_steps += 1
+                    step_hook(n_steps)
         finally:
             self.stats.preemptions = self.scheduler.preemptions
             self.stats.prefix_cache_hits = self.pool.prefix_hits
@@ -1124,6 +1189,13 @@ class ServingEngine:
             self._stream_cb = None
             if self.obs is not None:
                 self.obs.detach_compile_hook()
+                # publish every report already cached on the Generator for
+                # this engine's pool dtype: a fresh observer on a WARM
+                # model gets the warmup-time executable cost sheets
+                # without a single new lower/compile
+                for (_l, _k, variant), rep in self.gen._exec_reports.items():
+                    if variant == self.kv_dtype_name:
+                        self.obs.publish_device_report(rep)
                 hits = self.obs.metrics.counter(
                     "serving_prefix_hit_blocks_total",
                     "pool blocks reused copy-free",

@@ -4,6 +4,9 @@ The reference's only profiler is cProfile behind `--debug`
 (`/root/reference/src/sample.py:34-37,272-276`); here the same flag also
 captures a `jax.profiler` device trace (viewable in TensorBoard /
 Perfetto) — the TPU-native upgrade called out in SURVEY.md §7.
+`StepWindowProfiler` bounds that capture to N mid-run serving steps
+(`mdi-serve --xprof-steps`), so production-length replays yield
+fixed-size xplane artifacts.
 
 `CompileGuard` is the runtime companion to the `mdi-lint` static rules
 (docs/analysis.md): it counts jit traces and XLA backend compiles via
@@ -167,6 +170,60 @@ class CompileGuard:
                 "float static args, shape drift, or jit-in-loop "
                 "(run `mdi-lint` / see docs/analysis.md)"
             )
+
+
+class StepWindowProfiler:
+    """Bounded `jax.profiler` capture of N mid-run engine steps.
+
+    A production-length serving replay cannot wrap the whole run in a
+    trace — xplane captures grow with wall time and a multi-minute replay
+    produces an unloadable artifact.  This window starts the trace after
+    `skip` engine steps (past warmup compiles, into steady state) and
+    stops it `n_steps` later, so `mdi-serve --xprof-steps N` yields a
+    bounded deep profile of representative dispatches whatever the run
+    length.  Drive it from `ServingEngine.run(step_hook=prof.on_step)`;
+    `close()` (call it in a finally) stops a window left open by an early
+    exit — a dangling trace wedges later jax.profiler sessions.
+    """
+
+    def __init__(self, logdir: PathLike, n_steps: int, skip: int = 8):
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        self.logdir = str(logdir)
+        self.n_steps = int(n_steps)
+        self.skip = max(0, int(skip))
+        self.active = False
+        self.done = False
+        self.window: Optional[tuple] = None  # (first_step, last_step)
+
+    def on_step(self, i: int) -> None:
+        """Hook for the engine loop: `i` is the 1-based count of COMPLETED
+        steps.  The trace spans steps skip+1 .. skip+n_steps inclusive."""
+        if self.done:
+            return
+        self._last = i
+        if not self.active and i >= self.skip:
+            import jax
+
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+            self._first = i + 1
+            return
+        if self.active and i >= self.skip + self.n_steps:
+            self._stop()
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        self.window = (self._first, self._last)
+
+    def close(self) -> None:
+        """Stop a still-open window (short runs, exceptions)."""
+        if self.active:
+            self._stop()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,152 @@
+"""`mdi-doctor` (`cli/doctor.py`): per-stage subprocess isolation under
+hard timeouts (a fake wedged stage must come back as "timeout", fast,
+with the tool alive), the JSON snapshot schema, and the real --quick
+staged triage on the CPU backend — the tier-1 smoke that CI-gates the
+tool bench leans on for backend forensics.
+"""
+
+import json
+import time
+
+import pytest
+
+from mdi_llm_tpu.cli import doctor
+
+
+def _stage(name, code, timeout=5.0):
+    return {"name": name, "help": name, "timeout": timeout,
+            "quick": True, "code": code}
+
+
+# ---------------------------------------------------------------------------
+# per-stage subprocess machinery
+# ---------------------------------------------------------------------------
+
+
+def test_wedged_stage_hits_its_timeout_without_hanging_the_tool():
+    """THE reason the doctor exists: a stage that never answers (the
+    wedged-libtpu shape) is killed at its own hard timeout and recorded
+    as such — the tool returns promptly with the partial evidence."""
+    wedge = _stage("wedge", "import time; time.sleep(30)", timeout=0.5)
+    t0 = time.perf_counter()
+    rec = doctor.run_stage(wedge)
+    elapsed = time.perf_counter() - t0
+    assert rec["status"] == "timeout"
+    assert elapsed < 10.0, "the kill must not wait out the sleep"
+    assert rec["timeout_s"] == 0.5
+    assert "0.5" in rec["error"] and "killed" in rec["error"]
+    assert rec["elapsed_s"] >= 0.5
+
+
+def test_failed_stage_records_the_error_tail():
+    rec = doctor.run_stage(_stage("boom", "raise RuntimeError('kaboom')"))
+    assert rec["status"] == "failed"
+    assert "kaboom" in rec["error"]
+
+
+def test_skipped_stage_and_payload_parsing():
+    rec = doctor.run_stage(
+        _stage("skip", "import json; print(json.dumps({'skipped': 'n/a'}))")
+    )
+    assert rec["status"] == "skipped"
+    ok = doctor.run_stage(
+        _stage("ok", "import json; print('noise'); "
+                     "print(json.dumps({'answer': 42}))")
+    )
+    assert ok["status"] == "ok" and ok["detail"]["answer"] == 42
+
+
+def test_snapshot_schema_with_fake_stages():
+    """collect_snapshot over an injected stage list: schema fields, the
+    device identity lifted from the devices-style payload, and `ok`
+    reflecting the worst stage — all without touching a backend."""
+    stages = [
+        _stage("dev", "import json; print(json.dumps({"
+               "'platform': 'tpu', 'device_kind': 'TPU v5 lite',"
+               " 'device_count': 4}))"),
+        _stage("wedge", "import time; time.sleep(30)", timeout=0.5),
+    ]
+    snap = doctor.collect_snapshot(stages=stages)
+    assert snap["schema"] == doctor.SCHEMA_VERSION
+    assert snap["ok"] is False  # the wedge poisons overall health
+    assert snap["device_kind"] == "TPU v5 lite"
+    assert snap["backend"] == "tpu" and snap["device_count"] == 4
+    assert [r["name"] for r in snap["stages"]] == ["dev", "wedge"]
+    assert snap["stages"][1]["status"] == "timeout"
+    assert "versions" in snap and "hostname" in snap and "env" in snap
+    json.dumps(snap)  # the bench-embedded artifact must be JSON-clean
+    # stage_timeout overrides the per-stage budgets
+    t0 = time.perf_counter()
+    snap2 = doctor.collect_snapshot(stages=[stages[1]], stage_timeout=0.3)
+    assert snap2["stages"][0]["status"] == "timeout"
+    assert snap2["stages"][0]["timeout_s"] == 0.3
+    assert time.perf_counter() - t0 < 10.0
+
+
+def test_provenance_is_cheap_and_probe_scoped():
+    prov = doctor.provenance()
+    assert prov["versions"].get("jax"), "importlib.metadata must see jax"
+    assert prov["hostname"] and prov["python"]
+    # only backend-relevant env keys are captured
+    assert all(
+        k.startswith(("JAX_", "TPU_", "LIBTPU", "XLA_", "PJRT_"))
+        for k in prov["env"]
+    )
+    json.dumps(prov)
+
+
+# ---------------------------------------------------------------------------
+# the real staged triage on the CPU backend (tier-1 CI gate)
+# ---------------------------------------------------------------------------
+
+
+def test_quick_triage_healthy_on_cpu(tmp_path, capsys):
+    """mdi-doctor --quick end-to-end: three real stage subprocesses on the
+    CPU backend, healthy exit code, valid snapshot on stdout AND in the
+    --json file — the smoke that keeps the tool itself CI-gated."""
+    out_p = tmp_path / "doctor.json"
+    rc = doctor.main(["--quick", "--device", "cpu", "--json", str(out_p)])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    snap = json.loads(stdout.strip().splitlines()[-1])
+    file_snap = json.loads(out_p.read_text())
+    assert snap["ok"] is True and file_snap["ok"] is True
+    assert [r["name"] for r in snap["stages"]] == [
+        "import_jax", "devices", "matmul",
+    ]
+    assert all(r["status"] == "ok" for r in snap["stages"])
+    assert snap["backend"] == "cpu" and snap["device_kind"] == "cpu"
+    assert snap["versions"]["jax"] == snap["stages"][0]["detail"]["jax"]
+    assert snap["stages"][2]["detail"]["correct"] is True
+
+
+def test_unhealthy_snapshot_exits_nonzero(monkeypatch, capsys):
+    monkeypatch.setattr(
+        doctor, "STAGES", [_stage("boom", "raise SystemExit(3)")]
+    )
+    rc = doctor.main([])
+    assert rc == 1
+    snap = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert snap["ok"] is False
+
+
+def test_pyproject_registers_console_script():
+    from pathlib import Path
+
+    txt = (Path(__file__).resolve().parents[1] / "pyproject.toml").read_text()
+    assert 'mdi-doctor = "mdi_llm_tpu.cli.doctor:main"' in txt
+
+
+def test_cli_surface():
+    help_text = doctor.build_parser().format_help()
+    for flag in ("--quick", "--stage-timeout", "--json", "--device",
+                 "--list-stages"):
+        assert flag in help_text, flag
+    # the stage list is what --help/--list-stages document; pin the order
+    names = [s["name"] for s in doctor.STAGES]
+    assert names == ["import_jax", "devices", "matmul", "donation",
+                     "profiler_trace", "collective"]
+    assert [s["name"] for s in doctor.STAGES if s["quick"]] == [
+        "import_jax", "devices", "matmul",
+    ]
+    assert doctor.main(["--list-stages"]) == 0

@@ -1,0 +1,278 @@
+"""Serving roofline math (`mdi_llm_tpu/obs/roofline.py`): hand-computed
+decode FLOPs/bytes for two registry models (fp and int8 KV), the device
+peak table, MFU/MBU derivation, and THE tripwire — analytic FLOPs must
+agree with the XLA compiler's own `cost_analysis` on a real serving
+executable within the pinned tolerance, so the hand model can never
+silently rot away from what the executables compute.
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.config import Config, ServingConfig, dtype_bytes
+from mdi_llm_tpu.obs import roofline as rf
+
+# ---------------------------------------------------------------------------
+# hand-computed FLOPs: independent component-wise derivations for two
+# registry models (never via estimate_params — that is what's under test)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_flops_pythia_14m_hand_computed():
+    """pythia-14m: GPT-NeoX family — parallel residual, bias=True,
+    LayerNorm, GptNeoxMLP (2 matmuls, 4x intermediate), untied head."""
+    cfg = Config.from_name("pythia-14m")
+    assert cfg.mlp_class_name == "GptNeoxMLP" and cfg.bias
+    assert not cfg.tie_embeddings
+    D, L, V = cfg.n_embd, cfg.n_layer, cfg.padded_vocab_size
+    I, hs, H = cfg.intermediate_size, cfg.head_size, cfg.n_head
+    # per-layer linear params: fused QKV (+bias), attn out proj (+bias),
+    # MLP up/down (+biases), two LayerNorms (weight+bias each)
+    qkv = D * cfg.qkv_size + cfg.qkv_size
+    attn_out = hs * H * D + D
+    mlp = D * I + I + I * D + D
+    norms = 2 * D * 2
+    # non-gather params: L layers + final norm(+bias counts D via the
+    # trailing +D in estimate_params) + the lm_head matmul (V*D, untied)
+    lin = L * (qkv + attn_out + mlp + norms) + D + V * D
+    S = 96
+    expected = 2.0 * lin + 4.0 * L * H * hs * S
+    assert rf.decode_flops_per_token(cfg, S) == pytest.approx(expected)
+
+
+def test_decode_flops_tinyllama_hand_computed():
+    """tiny-llama-1.1b: Llama family — no bias, RMSNorm, LLaMAMLP
+    (3 matmuls), GQA (4 query groups), untied head."""
+    cfg = Config.from_name("tiny-llama-1.1b")
+    assert cfg.mlp_class_name == "LLaMAMLP" and not cfg.bias
+    D, L, V = cfg.n_embd, cfg.n_layer, cfg.padded_vocab_size
+    I, hs, H, G = cfg.intermediate_size, cfg.head_size, cfg.n_head, cfg.n_query_groups
+    q_per_kv = H // G
+    qkv = D * (q_per_kv + 2) * hs * G  # fused QKV at GQA width
+    attn_out = hs * H * D
+    mlp = 3 * D * I  # gate + up + down
+    norms = 2 * D  # two RMSNorm weights per layer
+    lin = L * (qkv + attn_out + mlp + norms) + D + V * D
+    S = 544
+    expected = 2.0 * lin + 4.0 * L * H * hs * S
+    assert rf.decode_flops_per_token(cfg, S) == pytest.approx(expected)
+    # and the inference estimate is exactly one third of the training
+    # 6N + 12·L·H·hs·T ... minus the gather-only embedding term
+    from mdi_llm_tpu.training import estimate_flops_per_token
+
+    train = estimate_flops_per_token(cfg, S)
+    assert rf.decode_flops_per_token(cfg, S) == pytest.approx(
+        train / 3.0 - 2.0 * V * D
+    )
+
+
+def test_prefill_flops_use_causal_mean_context():
+    cfg = Config.from_name("pythia-14m")
+    assert rf.prefill_flops_per_token(cfg, 100) == pytest.approx(
+        rf.decode_flops_per_token(cfg, 50)
+    )
+
+
+# ---------------------------------------------------------------------------
+# hand-computed HBM bytes: fp vs int8 paged pools at one block geometry
+# ---------------------------------------------------------------------------
+
+
+def test_decode_hbm_bytes_fp_vs_int8_hand_computed():
+    cfg = Config.from_name("pythia-14m")
+    L, G, hs = cfg.n_layer, cfg.n_query_groups, cfg.head_size
+    BS, S, B, Wb = 16, 100, 8, 10_000_000
+    n_blocks = math.ceil(S / BS)  # 7 whole blocks cover 100 tokens
+    fp_block = 2 * L * BS * G * hs * 2  # k+v, bf16
+    q8_block = 2 * L * BS * G * hs * 1 + 2 * L * G * 4  # int8 + f32 scales
+
+    got_fp = rf.decode_hbm_bytes_per_token(
+        cfg, ServingConfig(block_size=BS), B, S, Wb
+    )
+    assert got_fp["kv_read_bytes"] == n_blocks * fp_block
+    assert got_fp["kv_write_bytes"] == pytest.approx(2 * L * G * hs * 2)
+    assert got_fp["weight_bytes"] == pytest.approx(Wb / B)
+    assert got_fp["total_bytes"] == pytest.approx(
+        Wb / B + n_blocks * fp_block + 2 * L * G * hs * 2
+    )
+
+    got_q8 = rf.decode_hbm_bytes_per_token(
+        cfg, ServingConfig(block_size=BS, kv_dtype="int8"), B, S, Wb
+    )
+    assert got_q8["kv_dtype"] == "int8"
+    assert got_q8["kv_read_bytes"] == n_blocks * q8_block
+    # the int8 pool's MBU credit: roughly half the KV read traffic
+    assert got_q8["kv_read_bytes"] < 0.52 * got_fp["kv_read_bytes"]
+
+    # dense-cache path (serving=None): contiguous bytes, no block rounding
+    got_dense = rf.decode_hbm_bytes_per_token(cfg, None, B, S, Wb)
+    assert got_dense["kv_read_bytes"] == 2 * L * G * hs * S * 2
+
+
+def test_param_bytes_counts_storage_width():
+    # a mixed tree: f32 + int8 leaves count at their stored widths
+    tree = {
+        "w": jnp.zeros((4, 8), jnp.float32),
+        "q": jnp.zeros((16,), jnp.int8),
+    }
+    assert rf.param_bytes(tree) == 4 * 8 * 4 + 16
+    cfg = Config.from_name("pythia-14m")
+    assert cfg.estimate_param_bytes("float32") == cfg.estimate_params() * 4
+    assert cfg.estimate_param_bytes("bfloat16") == cfg.estimate_params() * 2
+
+
+# ---------------------------------------------------------------------------
+# the device-peak table
+# ---------------------------------------------------------------------------
+
+
+def test_device_peaks_matches_known_kinds():
+    assert rf.device_peaks("TPU v4") is rf.DEVICE_PEAKS["v4"]
+    assert rf.device_peaks("TPU v5 lite") is rf.DEVICE_PEAKS["v5e"]
+    assert rf.device_peaks("TPU v5e") is rf.DEVICE_PEAKS["v5e"]
+    assert rf.device_peaks("TPU v5p") is rf.DEVICE_PEAKS["v5p"]
+    assert rf.device_peaks("TPU v5") is rf.DEVICE_PEAKS["v5p"]  # bare v5 = p
+    assert rf.device_peaks("TPU v6 lite") is rf.DEVICE_PEAKS["v6e"]
+    assert rf.device_peaks("TPU v6e") is rf.DEVICE_PEAKS["v6e"]
+    # unknown kinds MUST map to None, never a guessed chip
+    for kind in ("cpu", "NVIDIA H100", "", None):
+        assert rf.device_peaks(kind) is None
+    # the table itself is sane: every row has both peaks, positive
+    for row in rf.DEVICE_PEAKS.values():
+        assert row["bf16_tflops"] > 0 and row["hbm_gbps"] > 0
+
+
+def test_serving_roofline_mfu_mbu_derivation():
+    cfg = Config.from_name("pythia-14m")
+    sv = ServingConfig(block_size=16)
+    tps, S, B, Wb = 1000.0, 256, 8, 28_000_000
+    out = rf.serving_roofline(
+        cfg, sv, tokens_per_s=tps, context=S, batch=B, weight_bytes=Wb,
+        device_kind="TPU v5 lite", n_chips=2,
+    )
+    flops_tok = rf.decode_flops_per_token(cfg, S)
+    bytes_tok = rf.decode_hbm_bytes_per_token(cfg, sv, B, S, Wb)["total_bytes"]
+    assert out["mfu"] == pytest.approx(tps * flops_tok / (2 * 197e12))
+    assert out["mbu"] == pytest.approx(tps * bytes_tok / (2 * 819e9))
+    assert out["achieved_tflops_per_s"] == pytest.approx(tps * flops_tok / 1e12)
+    json.dumps(out)  # the detail.device.roofline block must be JSON-clean
+
+    # unknown device: utilization is null, absolutes still report
+    out_cpu = rf.serving_roofline(
+        cfg, sv, tokens_per_s=tps, context=S, batch=B, weight_bytes=Wb,
+        device_kind="cpu",
+    )
+    assert out_cpu["mfu"] is None and out_cpu["mbu"] is None
+    assert out_cpu["achieved_tflops_per_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# THE tripwire: analytic FLOPs vs XLA cost_analysis on a real executable
+# ---------------------------------------------------------------------------
+
+
+def _cost_analysis_available() -> bool:
+    try:
+        f = jax.jit(lambda x: x @ x)
+        ca = f.lower(
+            jax.ShapeDtypeStruct((8, 8), jnp.float32)
+        ).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return bool(ca) and ca.get("flops") is not None
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(
+    not _cost_analysis_available(),
+    reason="backend does not expose AOT cost_analysis flops",
+)
+def test_analytic_flops_agree_with_xla_cost_analysis():
+    """Introspect the REAL serving mixed executable for a registry model
+    and pin analytic-vs-XLA agreement within `XLA_AGREEMENT_RTOL` — the
+    acceptance criterion that keeps `decode_flops_per_token` honest."""
+    from mdi_llm_tpu.generation import Generator
+    from mdi_llm_tpu.models import transformer
+    from mdi_llm_tpu.obs.device import introspect
+
+    cfg = Config.from_name("pythia-14m")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    gen = Generator(cfg, params, max_seq_length=128, cache_dtype=jnp.float32)
+    engine = gen.serve(block_size=8, max_batch=2, prefill_chunk=32)
+    B, T = 2, engine.token_budget
+    fn = engine._mixed_fn(B, T)
+    args = (
+        gen.params, np.zeros((1, T), np.int32), engine._kv, engine._tables,
+        np.zeros((1, T), np.int32), np.zeros((T,), np.int32),
+        np.zeros((B,), np.int32), np.zeros((B,), np.int32),
+        np.zeros((B,), np.int32), gen.key, engine._t_op, engine._p_op,
+    )
+    rep = introspect(
+        fn, args, {"mode": engine._sample_mode, "top_k": engine.cfg.top_k},
+        label="mixed", key=(B, T),
+    )
+    assert rep.error is None, rep.error
+    assert rep.flops and rep.flops > 0
+    assert rep.argument_bytes and rep.argument_bytes > 0
+    # every packed token attends the full table window (the fallback
+    # gathers every covered block) — the shape the analytic model costs
+    window = engine.max_blocks_per_seq * engine.pool.block_size
+    cross = rf.crosscheck_flops(
+        rep, T * rf.decode_flops_per_token(cfg, window)
+    )
+    assert cross["agrees"] is True, cross
+    assert cross["rel_err"] < rf.XLA_AGREEMENT_RTOL
+    json.dumps(cross)
+
+
+@pytest.mark.skipif(
+    not _cost_analysis_available(),
+    reason="backend does not expose AOT cost_analysis flops",
+)
+def test_int8_pool_executable_introspects_and_agrees():
+    """The quantized pool's executable (dict pytree of int8 blocks + f32
+    scales) must lower abstractly too, and its FLOPs stay within the same
+    tolerance — the in-kernel dequant is elementwise noise next to the
+    matmul terms."""
+    from mdi_llm_tpu.generation import Generator
+    from mdi_llm_tpu.models import transformer
+    from mdi_llm_tpu.obs.device import introspect
+
+    cfg = Config.from_name("pythia-14m")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    gen = Generator(cfg, params, max_seq_length=64, cache_dtype=jnp.float32)
+    engine = gen.serve(
+        serving=ServingConfig(block_size=8, max_batch=2, kv_dtype="int8")
+    )
+    B = 2
+    fn = engine._decode_fn(B)
+    args = (
+        gen.params, np.zeros((B,), np.int32), engine._kv, engine._tables,
+        np.zeros((B,), np.int32), gen.key, engine._t_op, engine._p_op,
+    )
+    rep = introspect(
+        fn, args, {"mode": engine._sample_mode, "top_k": engine.cfg.top_k},
+        label="decode", key=(B,), variant="int8",
+    )
+    assert rep.error is None, rep.error
+    window = engine.max_blocks_per_seq * engine.pool.block_size
+    cross = rf.crosscheck_flops(
+        rep, B * rf.decode_flops_per_token(cfg, window)
+    )
+    assert cross["agrees"] is True, cross
+
+
+def test_crosscheck_handles_missing_flops():
+    from mdi_llm_tpu.obs.device import ExecutableReport
+
+    rep = ExecutableReport(label="mixed", key=(1,), error="no AOT API")
+    out = rf.crosscheck_flops(rep, 1e9)
+    assert out["agrees"] is None and out["rel_err"] is None
