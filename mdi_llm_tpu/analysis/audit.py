@@ -106,6 +106,11 @@ AUDIT_RULES: Dict[str, Tuple[str, str]] = {
         ERROR, "the serving plan's mesh cannot shard the paged-KV pool "
         "(n_query_groups % tp != 0, or a dp/other >1 axis the engine "
         "does not support)"),
+    "bad-server-config": (
+        ERROR, "the open-system server config cannot serve: the admission "
+        "queue bound rejects everything, or it keeps every slot occupied "
+        "over a pool too small to hold all slots' reservation headroom "
+        "(sustained preemption thrash)"),
 }
 
 GiB = float(1 << 30)
@@ -760,6 +765,42 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
         )
     for p in problems:
         findings.append(_finding(plan, "bad-serving-config", p))
+    # open-system server sizing (server/frontend.py): only when the plan
+    # declares an admission queue — replay configs (admission_queue=None)
+    # never trip these, because without a front door the queue-vs-pool
+    # interaction does not exist
+    if sv.admission_queue is not None:
+        q = sv.admission_queue
+        if q < 1:
+            findings.append(_finding(
+                plan, "bad-server-config",
+                f"admission_queue={q} rejects every arrival: the server "
+                "would answer nothing but 429s (need >= 1; None defaults "
+                f"to {4 * sv.max_batch} = 4 x max_batch)",
+            ))
+        elif (
+            sv.max_blocks is not None and sv.block_size >= 1
+            and n_blocks >= 2 and headroom
+            and n_blocks - 1 < sv.max_batch * headroom
+        ):
+            # a bounded-queue front-end keeps every decode slot occupied
+            # under sustained load (that is its job), so unlike the
+            # one-slot replay bound above, the pool must hold EVERY
+            # slot's chunk-reservation headroom at once — below that the
+            # saturated steady state is preemption thrash: each chunk
+            # reservation evicts a neighbor, recompute work crowds out
+            # serving work, and goodput collapses exactly when traffic
+            # peaks
+            findings.append(_finding(
+                plan, "bad-server-config",
+                f"admission_queue={q} keeps all {sv.max_batch} slots "
+                f"occupied under load, but max_blocks={sv.max_blocks} "
+                f"leaves {n_blocks - 1} usable block(s) < max_batch x "
+                f"{headroom}-block reservation headroom "
+                f"({sv.max_batch * headroom}): the saturated steady state "
+                "is preemption thrash — grow the pool or shrink "
+                "max_batch/decode_chunk",
+            ))
     # unified-step token budget: the mixed batch packs one decode token per
     # live slot FIRST, then prefill chunk tokens — a budget at or below
     # max_batch starves prefill forever (the engine refuses it too).  The
@@ -812,6 +853,9 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             "spec_k": sv.spec_k,
             "reserve_headroom_blocks": headroom,
             "token_budget": sv.resolved_token_budget(),
+            # open-system bound (None for replay configs): the
+            # bad-server-config checker sized it against the headroom
+            "admission_queue": sv.admission_queue,
         }
 
 

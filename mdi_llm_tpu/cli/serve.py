@@ -102,6 +102,17 @@ def build_parser():
                     help="disable hash-based prefix block reuse")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="engine-wide sampling temperature (0 = greedy)")
+    ap.add_argument("--policy", default="fcfs",
+                    choices=("fcfs", "priority", "fair", "deadline"),
+                    help="scheduling policy (serving/policy.py): admission "
+                    "order and prefill packing order — fcfs (default, the "
+                    "historical scheduler), priority (higher "
+                    "Request.priority first), fair (per-tenant fair-share "
+                    "token accounting), deadline (TTFT-SLO "
+                    "earliest-deadline-first admission + least-slack "
+                    "prefill packing).  Replay traces carry default "
+                    "priority/tenant/SLO attributes, so non-FCFS policies "
+                    "mainly matter through mdi-server's HTTP API")
     ap.add_argument("--no-preflight", action="store_true",
                     help="downgrade a failing mdi-audit preflight to a "
                     "warning instead of refusing to launch")
@@ -174,27 +185,16 @@ def synthetic_trace(n: int, vocab: int, max_seq: int, max_new: int, seed=10137):
     return reqs
 
 
-def main(argv=None):
-    args = build_parser().parse_args(argv)
-    setup_logging(args)
-    select_device(args)
-
-    import numpy as np
-
-    from mdi_llm_tpu.generation import Generator
-
-    # static plan audit BEFORE the checkpoint load (mdi-audit preflight:
-    # pool geometry, divisibility, optional --hbm-gb budget — a refused
-    # plan must not pay the weight load; docs/analysis.md "Plan audit")
-    from mdi_llm_tpu.analysis.audit import enforce_preflight, preflight
-    from mdi_llm_tpu.cli._common import resolve_config
+def make_serving_config(args, admission_queue=None):
+    """The `ServingConfig` the CLI flags describe — shared by the replay
+    driver here and the open-system `mdi-server` (`cli/server.py`), so
+    both audit and run EXACTLY the same config."""
     from mdi_llm_tpu.config import ServingConfig
 
     # --kv-dtype int8 selects the QUANTIZED POOL (ServingConfig.kv_dtype:
     # int8 blocks + per-block scales, ~2x resident sequences per HBM byte);
     # the dense-cache cast dtypes keep flowing through cache_dtype below
-    pool_int8 = args.kv_dtype == "int8"
-    serving_cfg = ServingConfig(
+    return ServingConfig(
         block_size=args.block_size,
         max_blocks=args.max_blocks,
         max_batch=args.max_batch,
@@ -205,8 +205,18 @@ def main(argv=None):
         double_buffer=not args.no_double_buffer,
         prefix_caching=not args.no_prefix_cache,
         temperature=args.temperature,
-        kv_dtype="int8" if pool_int8 else None,
+        kv_dtype="int8" if args.kv_dtype == "int8" else None,
+        admission_queue=admission_queue,
     )
+
+
+def preflight_serving(args, serving_cfg, origin):
+    """mdi-audit preflight + the pool-size log line (shared with
+    `mdi-server`).  Runs BEFORE the checkpoint load: a refused plan must
+    not pay the weight load (docs/analysis.md "Plan audit")."""
+    from mdi_llm_tpu.analysis.audit import enforce_preflight, preflight
+    from mdi_llm_tpu.cli._common import resolve_config
+
     report = preflight(
         resolve_config(args),
         tp=args.tp,
@@ -217,9 +227,9 @@ def main(argv=None):
         quantize=args.quantize,
         serving=serving_cfg,
         hbm_gb=args.hbm_gb,
-        origin="mdi-serve",
+        origin=origin,
     )
-    enforce_preflight(report, "mdi-serve", allow=args.no_preflight)
+    enforce_preflight(report, origin, allow=args.no_preflight)
     pool = report.breakdown.get("kv_pool", {})
     if pool:
         per_dev = (
@@ -231,22 +241,27 @@ def main(argv=None):
             if pool.get("kv_dtype") == "int8" else ""
         )
         print(
-            f"mdi-serve: KV pool {pool['num_blocks']} blocks x "
+            f"{origin}: KV pool {pool['num_blocks']} blocks x "
             f"{pool['block_size']} tokens ~= {pool['pool_bytes'] / 2**20:.1f}"
             f" MiB{q_tag}{per_dev}",
             file=sys.stderr,
         )
+    return report
 
-    cfg, params, tokenizer, _style = load_model(
-        args, need_tokenizer=not args.synthetic
-    )
+
+def build_generator(args, cfg, params):
+    """The serving `Generator` the CLI flags describe (tp mesh, cache
+    dtype, quantization) — shared with `mdi-server`."""
+    from mdi_llm_tpu.generation import Generator
+
     dtype = DTYPES[args.dtype]
+    pool_int8 = args.kv_dtype == "int8"
     mesh = None
     if args.tp > 1:
         from mdi_llm_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh({"tp": args.tp})
-    gen = Generator(
+    return Generator(
         cfg, params,
         max_seq_length=args.sequence_length,
         cache_dtype=(
@@ -257,16 +272,34 @@ def main(argv=None):
         mesh=mesh,
         scan_unroll=args.scan_unroll,
     )
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    setup_logging(args)
+    select_device(args)
+
+    import numpy as np
+
+    serving_cfg = make_serving_config(args)
+    preflight_serving(args, serving_cfg, "mdi-serve")
+
+    cfg, params, tokenizer, _style = load_model(
+        args, need_tokenizer=not args.synthetic
+    )
+    gen = build_generator(args, cfg, params)
     # observability rides every run (its hooks are host-side appends at
     # sync boundaries the loop already owns — docs/observability.md); the
     # file flags only decide what gets WRITTEN at the end
     from mdi_llm_tpu.obs import ServingObserver
+    from mdi_llm_tpu.serving.policy import make_policy
 
     obs = ServingObserver(ring=args.trace_ring,
                           rss_interval_s=args.sample_rss,
                           device=not args.no_device_obs)
     # the audited config IS the engine config — no second hand-kept copy
-    engine = gen.serve(serving=serving_cfg, obs=obs)
+    engine = gen.serve(serving=serving_cfg, obs=obs,
+                       policy=make_policy(args.policy))
 
     if args.synthetic:
         trace = synthetic_trace(

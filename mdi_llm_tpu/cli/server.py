@@ -1,0 +1,147 @@
+"""Open-system HTTP serving daemon over the paged-KV engine.
+
+Where `mdi-serve` replays a fixed trace and exits, `mdi-server` stands
+up the live system (docs/serving.md): the continuous-batching engine
+runs in a dedicated thread behind a bounded admission queue, and an
+asyncio HTTP front door streams tokens to clients over SSE —
+
+    POST /v1/completions   JSON in; SSE token stream or JSON out
+    GET  /healthz          liveness + queue/lane depths
+    GET  /v1/stats         canonical ServingStats + latency percentiles
+    GET  /metrics          Prometheus text exposition
+
+Backpressure is explicit: arrivals past ``--admission-queue`` get 429 +
+Retry-After (shed load is measurable load, not a crash), and SIGINT/
+SIGTERM trigger a graceful drain — stop accepting, finish in-flight
+streams, stop the engine — bounded by ``--drain-timeout``.
+
+Scheduling is policy-pluggable (``--policy``): priority classes,
+per-tenant fair share and TTFT-deadline EDF ride the request body's
+``priority`` / ``tenant`` / ``ttft_slo_ms`` fields.
+
+Examples::
+
+    # synthetic-weight dev server on port 8080, fair-share scheduling
+    python -m mdi_llm_tpu.cli.server --model NanoLlama --port 8080 \
+        --max-batch 8 --policy fair
+
+    # real checkpoint (text prompts + decoded SSE), deadline scheduling
+    python -m mdi_llm_tpu.cli.server --ckpt checkpoints/TinyLlama/... \
+        --policy deadline --admission-queue 64
+
+    curl -N localhost:8080/v1/completions -d \
+        '{"prompt": "Once upon a time,", "max_tokens": 64, "stream": true}'
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import sys
+
+from mdi_llm_tpu.cli._common import load_model, select_device, setup_logging
+
+
+def build_parser():
+    import argparse
+
+    from mdi_llm_tpu.cli.serve import build_parser as serve_parser
+
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        parents=[serve_parser()], conflict_handler="resolve", add_help=True,
+    )
+    # the replay-trace knobs stay (they size nothing here) but the server
+    # adds its own surface on top
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (0.0.0.0 exposes the server beyond "
+                    "localhost — it speaks plaintext HTTP with no auth, so "
+                    "front it with something that terminates TLS first)")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="TCP port (0 = ephemeral, printed at startup)")
+    ap.add_argument("--admission-queue", type=int, default=None,
+                    help="bound on accepted-but-not-yet-scheduled requests; "
+                    "arrivals past it get HTTP 429 + Retry-After instead of "
+                    "growing an unbounded queue (default 4 x max-batch; "
+                    "mdi-audit checks it against the pool's headroom — "
+                    "bad-server-config)")
+    ap.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="graceful-shutdown bound (s): on SIGINT/SIGTERM "
+                    "stop accepting (503), wait this long for in-flight "
+                    "requests to finish, then stop the engine thread")
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    setup_logging(args)
+    select_device(args)
+
+    from mdi_llm_tpu.cli.serve import (
+        build_generator,
+        make_serving_config,
+        preflight_serving,
+    )
+    from mdi_llm_tpu.obs import ServingObserver
+    from mdi_llm_tpu.serving.policy import make_policy
+
+    serving_cfg = make_serving_config(
+        args, admission_queue=args.admission_queue
+    )
+    preflight_serving(args, serving_cfg, "mdi-server")
+
+    # tokenizer is optional here: token-id requests always work, text
+    # prompts 400 without one (the HTTP layer explains)
+    cfg, params, tokenizer, _style = load_model(args, need_tokenizer=False)
+    gen = build_generator(args, cfg, params)
+    obs = ServingObserver(ring=args.trace_ring,
+                          rss_interval_s=args.sample_rss,
+                          device=not args.no_device_obs)
+    engine = gen.serve(serving=serving_cfg, obs=obs,
+                       policy=make_policy(args.policy))
+
+    from mdi_llm_tpu.server import ServingFrontend
+    from mdi_llm_tpu.server.http import ServingHTTPServer
+
+    frontend = ServingFrontend(engine, max_queue=args.admission_queue)
+    server = ServingHTTPServer(
+        frontend, host=args.host, port=args.port, tokenizer=tokenizer,
+        drain_timeout_s=args.drain_timeout,
+    )
+
+    async def run():
+        await server.start()
+        print(
+            f"mdi-server: serving {cfg.name} on "
+            f"http://{args.host}:{server.port} (policy={args.policy}, "
+            f"slots={args.max_batch}, admission queue "
+            f"{frontend.max_queue}; POST /v1/completions, GET /healthz)",
+            file=sys.stderr,
+        )
+        loop = asyncio.get_running_loop()
+        drain = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, drain.set)
+            except NotImplementedError:  # non-unix event loops
+                pass
+        await drain.wait()
+        print("mdi-server: draining (new requests get 503) ...",
+              file=sys.stderr)
+        await server.shutdown()
+        # the same canonical stats line mdi-serve prints, so a server
+        # session lands in logs exactly like a replay run
+        line = engine.stats.to_dict()
+        line["latency"] = {
+            name: {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in summ.items()}
+            for name, summ in obs.latency_summaries().items()
+        }
+        print(json.dumps(line), file=sys.stderr)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -491,6 +491,24 @@ class ServingConfig:
     # budget holds; other float names cast on write like the dense cache's
     # --kv-dtype.  Unknown names are refused via `dtype_bytes`.
     kv_dtype: Optional[str] = None
+    # open-system front-end (server/frontend.py): bound on requests the
+    # server has ACCEPTED but not yet seated in a decode slot (the
+    # submission channel plus the scheduler's waiting queue).  Arrivals
+    # past the bound are rejected with backpressure (HTTP 429) instead of
+    # growing an unbounded queue whose tail latency no SLO survives.
+    # None → the replay engine's behavior (no bound; mdi-serve queues the
+    # whole trace) and the server default of 4 × max_batch.
+    admission_queue: Optional[int] = None
+
+    def resolved_admission_queue(self) -> int:
+        """The open-system admission-queue bound: `admission_queue` when
+        set, else 4 × max_batch — deep enough to keep every slot fed
+        through retirement churn, shallow enough that queue-wait cannot
+        silently dominate TTFT.  Shared by `server.ServingFrontend` and
+        the mdi-audit `bad-server-config` checker."""
+        if self.admission_queue is not None:
+            return int(self.admission_queue)
+        return 4 * self.max_batch
 
     def resolved_token_budget(self) -> int:
         """The unified serving step's per-dispatch token-axis width: every

@@ -995,7 +995,7 @@ class Generator:
         """A stateful conversation handle with cross-turn KV reuse."""
         return ChatSession(self)
 
-    def serve(self, serving=None, obs=None, **knobs):
+    def serve(self, serving=None, obs=None, policy=None, **knobs):
         """A paged-KV continuous-batching engine bound to this model
         (serving/engine.py): request queue, unified token-budget steps
         (decode lanes + prefill chunks in ONE ragged forward per
@@ -1018,6 +1018,13 @@ class Generator:
         tracing and TTFT/TPOT percentile metrics — fed only at the
         engine's existing host-sync boundaries, so enabling it changes
         no dispatch, sync or compile behaviour (docs/observability.md).
+
+        `policy` takes a `serving.policy.SchedulingPolicy` (or None for
+        FCFS): admission order and prefill packing order become
+        pluggable — priority classes, per-tenant fair share,
+        TTFT-deadline EDF — while dispatch shapes and the sync cadence
+        stay structurally identical (docs/serving.md "Scheduling
+        policies").
         """
         from mdi_llm_tpu.config import ServingConfig
         from mdi_llm_tpu.serving.engine import (
@@ -1032,7 +1039,7 @@ class Generator:
             serving = ServingConfig(**knobs)
         elif knobs:
             raise ValueError("pass a ServingConfig or keywords, not both")
-        return ServingEngine(self, serving, obs=obs)
+        return ServingEngine(self, serving, obs=obs, policy=policy)
 
 
 

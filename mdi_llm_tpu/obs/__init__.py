@@ -197,6 +197,16 @@ class ServingObserver:
                                  "prompt tokens served from the prefix "
                                  "cache").inc(n_cached)
 
+    def request_rejected(self, rid: str) -> None:
+        """Open-system backpressure: an arrival bounced at the admission
+        queue bound (server/frontend.py → HTTP 429).  Counter only — a
+        rejected request never opens a timing record, so the latency
+        percentiles describe SERVED traffic (the SLO convention: rejected
+        load is reported separately, not averaged in)."""
+        self.metrics.counter("serving_requests_rejected_total",
+                             "arrivals rejected by admission "
+                             "backpressure").inc()
+
     def request_preempted(self, rid: str, n_generated: int) -> None:
         self.tracer.request_preempted(rid, n_generated)
         self.metrics.counter("serving_preemptions_total",

@@ -1,0 +1,46 @@
+"""Open-system serving front-end over the paged-KV engine.
+
+Turns the replay-a-trace-and-exit `ServingEngine` into a live system
+(docs/serving.md):
+
+- `frontend.ServingFrontend` — the concurrency bridge: the engine loop
+  runs in ONE dedicated thread (the only thread that ever touches the
+  scheduler, pool or device arrays), arrivals flow through a bounded
+  thread-safe submission channel drained at the engine's `step_hook`
+  seam, tokens stream out per request through the `stream_cb` seam, and
+  backpressure/drain/cancel are first-class.
+- `http.ServingHTTPServer` — an asyncio HTTP/1.1 front door over the
+  frontend: JSON POST completions with SSE token streaming, 429
+  admission backpressure, health/metrics endpoints, graceful drain on
+  shutdown.  Stdlib only (asyncio streams — no CherryPy, no pickle:
+  the reference repo's node control plane reproduced TPU-natively).
+- `loadgen` — open-loop arrival generation (Poisson, replayed-trace)
+  and the offered-load sweep that finds the max QPS meeting a p99
+  TTFT/TPOT SLO (`bench.py --mode serve-open`).
+"""
+
+from mdi_llm_tpu.server.frontend import (
+    FrontendClosedError,
+    QueueFullError,
+    RequestHandle,
+    ServingFrontend,
+)
+from mdi_llm_tpu.server.loadgen import (
+    ArrivalSpec,
+    OpenLoopRunner,
+    poisson_arrivals,
+    replay_arrivals,
+    sweep_offered_load,
+)
+
+__all__ = [
+    "ArrivalSpec",
+    "FrontendClosedError",
+    "OpenLoopRunner",
+    "QueueFullError",
+    "RequestHandle",
+    "ServingFrontend",
+    "poisson_arrivals",
+    "replay_arrivals",
+    "sweep_offered_load",
+]

@@ -1,15 +1,32 @@
-"""Paged-KV serving subsystem: block pool, continuous-batching scheduler,
-and the `ServingEngine` request loop (see docs/perf.md "Serving")."""
+"""Paged-KV serving subsystem: block pool, continuous-batching scheduler
+with pluggable policies, and the `ServingEngine` request loop (see
+docs/perf.md "Serving" and docs/serving.md for the open-system layer)."""
 
 from mdi_llm_tpu.serving.kv_pool import KVPool
+from mdi_llm_tpu.serving.policy import (
+    POLICIES,
+    DeadlinePolicy,
+    FairSharePolicy,
+    FCFSPolicy,
+    PriorityPolicy,
+    SchedulingPolicy,
+    make_policy,
+)
 from mdi_llm_tpu.serving.scheduler import Request, Scheduler, SequenceState
 from mdi_llm_tpu.serving.engine import ServingEngine, ServingStats
 
 __all__ = [
     "KVPool",
+    "POLICIES",
+    "DeadlinePolicy",
+    "FairSharePolicy",
+    "FCFSPolicy",
+    "PriorityPolicy",
     "Request",
     "Scheduler",
+    "SchedulingPolicy",
     "SequenceState",
     "ServingEngine",
     "ServingStats",
+    "make_policy",
 ]

@@ -186,6 +186,14 @@ class ServingStats:
     spec_accepted: int = 0  # draft tokens accepted (emitted without a step)
     requests_finished: int = 0
     preemptions: int = 0
+    # open-system fields (server/frontend.py fills them; replay runs keep
+    # the zero defaults so both modes report ONE schema — a bench
+    # serve-open row and an mdi-serve replay line are key-compatible)
+    requests_rejected: int = 0  # admission-queue backpressure (429s)
+    queue_depth_peak: int = 0  # max waiting+preempted seen at any step
+    offered_qps: float = 0.0  # arrival rate offered by the open-loop
+    # driver (submissions/second including rejected ones); 0 in replay
+    # mode where the whole trace is queued up front
     # peak concurrently-resident sequences (live lanes holding pool blocks
     # in one dispatch) — THE capacity number a quantized pool moves at
     # fixed HBM (the serving-cb-int8 bench rung reads it off this field)
@@ -283,6 +291,9 @@ class ServingStats:
             "prefix_cache_hits": self.prefix_cache_hits,
             "preemptions": self.preemptions,
             "resident_peak": self.resident_peak,
+            "requests_rejected": self.requests_rejected,
+            "queue_depth_peak": self.queue_depth_peak,
+            "offered_qps": round(self.offered_qps, 3),
         }
 
 
@@ -300,7 +311,8 @@ class ServingEngine:
     the module docstring); token streams are identical to single-device.
     """
 
-    def __init__(self, gen: Generator, serving: ServingConfig, obs=None):
+    def __init__(self, gen: Generator, serving: ServingConfig, obs=None,
+                 policy=None):
         validate_serving_mesh(gen.mesh)  # serve() checks too; direct
         # constructions must hit the same wall before the pool allocates
         self.gen = gen
@@ -392,7 +404,7 @@ class ServingEngine:
         self.pool = KVPool(num_blocks, bs, prefix_caching=serving.prefix_caching)
         self.scheduler = Scheduler(
             self.pool, serving.max_batch, serving.prefill_chunk,
-            self.max_seq_length,
+            self.max_seq_length, policy=policy,
         )
         self.scheduler.observer = obs  # lifecycle edges report from there
         self._kv = gen._place_paged_kv(transformer.init_paged_kv_cache(
@@ -639,12 +651,19 @@ class ServingEngine:
         prompt: Sequence[int],
         max_new_tokens: int,
         stop_sequences: Sequence[Sequence[int]] = (),
+        priority: int = 0,
+        tenant: str = "",
+        ttft_slo_s: Optional[float] = None,
     ) -> str:
-        """Queue a request; raises ValueError if it can never fit."""
+        """Queue a request; raises ValueError if it can never fit.
+        `priority`/`tenant`/`ttft_slo_s` feed the scheduling policy
+        (serving/policy.py) and are inert under the default FCFS."""
         self.scheduler.add(Request(
             rid=rid, prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens),
             stop_sequences=stop_sequences,
+            priority=int(priority), tenant=str(tenant),
+            ttft_slo_s=ttft_slo_s,
         ))
         return rid
 
@@ -820,6 +839,15 @@ class ServingEngine:
             or len(seq.tokens) >= self.max_seq_length
         ):
             self._finish(seq)
+
+    def pop_result(self, rid: str) -> Optional[List[int]]:
+        """Take one finished request's token list (prompt + generation,
+        stop-trimmed) out of the engine, or None if it has not finished.
+        The open-system front-end (`server/frontend.py`) collects results
+        through this so a long-lived engine's result map stays bounded by
+        requests in flight, not by traffic history; the replay `run()`
+        return value is unaffected (it snapshots before anyone pops)."""
+        return self._results.pop(rid, None)
 
     def _finish(self, seq: SequenceState) -> None:
         gen_tokens = seq.generated()
@@ -1147,6 +1175,12 @@ class ServingEngine:
         with every decode lane; pure-decode turns run the multi-token
         machinery (chunked scan / speculative verify) unchanged."""
         action = self.scheduler.next_batch(self.token_budget)
+        # queue-depth high-water mark AFTER admission: what next_batch
+        # could not seat this step (the open-system congestion signal;
+        # two host-side len() reads, no device work)
+        self.stats.queue_depth_peak = max(
+            self.stats.queue_depth_peak, self._queue_depth()
+        )
         if action is None:
             return False
         if action[0] == "mixed":

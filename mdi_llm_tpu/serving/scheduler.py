@@ -1,9 +1,11 @@
 """Continuous-batching request scheduler.
 
 Policy layer between the request queue and the device loop
-(`serving.engine.ServingEngine`): FCFS admission into a fixed set of decode
-slots, token-budget mixed-batch composition (Sarathi-style: decode lanes
-first, then prefill chunks split to fit), mid-batch retirement, and
+(`serving.engine.ServingEngine`): policy-ordered admission into a fixed
+set of decode slots (`serving/policy.py` — FCFS by default, priority /
+per-tenant fair-share / TTFT-deadline pluggable), token-budget
+mixed-batch composition (Sarathi-style: decode lanes first, then prefill
+chunks split to fit, packed in policy order), mid-batch retirement, and
 recompute-style preemption when the block pool runs dry.
 
 The scheduler never touches device arrays — it owns `SequenceState`
@@ -34,6 +36,7 @@ from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Sequence, Tuple
 
 from mdi_llm_tpu.serving.kv_pool import KVPool
+from mdi_llm_tpu.serving.policy import FCFSPolicy, SchedulingPolicy
 
 __all__ = ["Request", "SequenceState", "Scheduler"]
 
@@ -44,6 +47,12 @@ class Request:
     prompt: List[int]
     max_new_tokens: int
     stop_sequences: Sequence[Sequence[int]] = ()
+    # open-system scheduling attributes (serving/policy.py): ignored by
+    # the default FCFS policy, so replay traces behave exactly as before
+    priority: int = 0  # higher admits first under PriorityPolicy
+    tenant: str = ""  # fair-share accounting key (FairSharePolicy)
+    ttft_slo_s: Optional[float] = None  # TTFT deadline relative to arrival
+    arrival_s: Optional[float] = None  # stamped by the policy at add()
 
 
 class SequenceState:
@@ -92,13 +101,20 @@ class SequenceState:
 
 class Scheduler:
     def __init__(self, pool: KVPool, max_batch: int, prefill_chunk: int,
-                 max_seq_length: int):
+                 max_seq_length: int,
+                 policy: Optional[SchedulingPolicy] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.pool = pool
         self.max_batch = max_batch
         self.prefill_chunk = max(1, prefill_chunk)
         self.max_seq_length = max_seq_length
+        # scheduling policy (serving/policy.py): decides which waiting
+        # request takes the next free slot and in what order prefilling
+        # sequences split the unified step's token budget.  Pure host-side
+        # reordering — dispatch shapes and sync cadence cannot change.
+        # The default is FCFS, bit-identical to the pre-policy scheduler.
+        self.policy = policy if policy is not None else FCFSPolicy()
         self.waiting: Deque[Request] = deque()
         # preempted sequences resume before fresh admissions (they hold
         # progress the pool already paid for once)
@@ -116,7 +132,11 @@ class Scheduler:
 
     # -- queue ---------------------------------------------------------------
 
-    def add(self, req: Request) -> None:
+    def validate(self, req: Request) -> None:
+        """The add-time feasibility wall, callable WITHOUT mutating any
+        scheduler state: pure reads of pool/window constants, so the
+        open-system front-end can pre-check a submission from its own
+        thread (HTTP 400) before the engine thread ever sees it."""
         total = len(req.prompt) + req.max_new_tokens
         if len(req.prompt) < 1:
             raise ValueError(f"request {req.rid}: empty prompt")
@@ -137,6 +157,10 @@ class Scheduler:
                 f"request {req.rid}: needs {self.pool.blocks_needed(total)} "
                 f"blocks, pool has {self.pool.num_blocks - 1}"
             )
+
+    def add(self, req: Request) -> None:
+        self.validate(req)
+        self.policy.on_submitted(req)  # stamps arrival_s for deadlines
         self.waiting.append(req)
         if self.observer is not None:
             self.observer.request_submitted(
@@ -188,8 +212,13 @@ class Scheduler:
         return seq
 
     def admit(self) -> List[SequenceState]:
-        """FCFS admission (preempted first): stop at the first request that
-        does not fit — head-of-line order keeps starvation impossible."""
+        """Policy-ordered admission, preempted sequences first (they hold
+        progress the pool already paid for once, whatever the policy).
+        Admission stops at the first pick that does not fit — the policy's
+        choice blocks the queue rather than being skipped, so block
+        accounting stays conservative and the pick can never be starved
+        by later arrivals it ranked above (FCFS keeps its historical
+        head-of-line no-starvation guarantee as the default policy)."""
         admitted = []
         while self.preempted:
             req, toks = self.preempted[0]
@@ -199,10 +228,13 @@ class Scheduler:
             self.preempted.popleft()
             admitted.append(seq)
         while self.waiting:
-            seq = self._try_admit_one(self.waiting[0], None)
+            idx = self.policy.select_next(self.waiting, self.running())
+            if idx is None:
+                return admitted
+            seq = self._try_admit_one(self.waiting[idx], None)
             if seq is None:
                 return admitted
-            self.waiting.popleft()
+            del self.waiting[idx]
             admitted.append(seq)
         return admitted
 
@@ -216,6 +248,7 @@ class Scheduler:
         self.pool.release(seq.blocks)
         seq.blocks = []
         self.finished.append(seq)
+        self.policy.on_retired(seq)  # fair-share usage accounting
         if self.observer is not None:
             self.observer.request_finished(seq.req.rid)
 
@@ -309,9 +342,14 @@ class Scheduler:
         prefill token fits every mixed step, so prefill always makes
         progress."""
         self.admit()
-        prefilling = sorted(
-            (s for s in self.running() if s.needs_prefill),
-            key=lambda s: s.admit_order,
+        # packing order is the policy's second seam: FCFS returns
+        # admission order (the historical behavior); DeadlinePolicy puts
+        # the least-slack TTFT deadline first so an urgent prompt takes
+        # the leftover budget before relaxed ones.  Reordering only —
+        # chunking and the dispatch shape are untouched.
+        prefilling = self.policy.order_prefill(
+            [s for s in self.running() if s.needs_prefill],
+            now=self.policy.clock(),
         )
         decoding = [
             s for s in self.running()

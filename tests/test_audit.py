@@ -209,6 +209,43 @@ def test_bad_serving_config_rejected():
     assert r.breakdown["per_device"]["kv_bytes"] == 0
 
 
+def test_bad_server_config_rejected():
+    """The open-system sizing check (bad-server-config): an admission
+    queue that rejects everything, and a queue-backed server whose pool
+    cannot hold every slot's chunk-reservation headroom at once (the
+    saturated steady state would be preemption thrash).  Replay configs
+    (admission_queue=None) never trip it."""
+    # queue bound rejects every arrival
+    r = audit_plan(PlanSpec(
+        cfg=tiny(), serving=ServingConfig(block_size=16, admission_queue=0),
+    ))
+    assert codes(r) == ["bad-server-config"]
+    assert "rejects every arrival" in r.findings[0].message
+    # saturated-slots headroom: per-slot headroom for decode_chunk=16,
+    # double_buffer, block 4 is 9 blocks; 8 slots need 72, a 40-block
+    # pool holds all slots' FIRST writes (one-slot replay bound passes:
+    # 39 usable >= 9+1) but not the saturated reservation demand
+    sv_open = ServingConfig(block_size=4, decode_chunk=16, max_batch=8,
+                            max_blocks=40, admission_queue=32)
+    r = audit_plan(PlanSpec(cfg=tiny(), serving=sv_open))
+    assert codes(r) == ["bad-server-config"]
+    assert "preemption thrash" in r.findings[0].message
+    # the SAME pool with no admission queue is the replay config mdi-serve
+    # runs — it must stay clean (one-slot headroom suffices there)
+    sv_replay = ServingConfig(block_size=4, decode_chunk=16, max_batch=8,
+                              max_blocks=40)
+    assert codes(audit_plan(PlanSpec(cfg=tiny(), serving=sv_replay))) == []
+    # a well-sized open config is clean and the breakdown carries the bound
+    sv_ok = ServingConfig(block_size=4, decode_chunk=16, max_batch=8,
+                          admission_queue=32)
+    r = audit_plan(PlanSpec(cfg=tiny(), serving=sv_ok))
+    assert codes(r) == []
+    assert r.breakdown["kv_pool"]["admission_queue"] == 32
+    # resolved default: 4 x max_batch (shared with ServingFrontend)
+    assert sv_replay.resolved_admission_queue() == 32
+    assert sv_open.resolved_admission_queue() == 32
+
+
 def test_serving_chunk_headroom_budgeted():
     """The pool-sizing audit accounts for K-step reservation headroom: a
     hand-sized max_blocks pool that cannot hold even one slot's chunk

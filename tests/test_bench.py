@@ -39,9 +39,17 @@ def _row(value, model="tiny-llama-1.1b", vs=None):
     }
 
 
-def run_suite_with(monkeypatch, child_fn, **args_kw):
+def run_suite_with(monkeypatch, child_fn, hardware=True, **args_kw):
     monkeypatch.setattr(bench, "_child", child_fn)
     monkeypatch.setattr(bench.time, "sleep", lambda *_: None)
+    # the suite gates probing on host-local TPU hardware evidence (the r6
+    # wedge fix); these orchestration tests simulate children, so claim
+    # hardware unless the test IS about the no-hardware fast path
+    monkeypatch.setattr(
+        bench, "_tpu_hardware_evidence",
+        lambda: {"present": hardware, "dev_accel": [], "dev_vfio": [],
+                 "env": {"TPU_NAME": "sim"} if hardware else {}},
+    )
     return bench.run_suite(_args(**args_kw))
 
 
@@ -271,6 +279,13 @@ def test_probe_budget_is_a_hard_total_cap(monkeypatch):
     monkeypatch.setattr(
         bench.time, "sleep", lambda s: clock.__setitem__(0, clock[0] + s)
     )
+    # this test drives run_suite directly (fake clock), so it claims
+    # hardware evidence itself — the no-hardware path never probes at all
+    monkeypatch.setattr(
+        bench, "_tpu_hardware_evidence",
+        lambda: {"present": True, "dev_accel": [], "dev_vfio": [],
+                 "env": {"TPU_NAME": "sim"}},
+    )
     probes = []
 
     def child(argv, timeout, env=None):
@@ -477,3 +492,109 @@ def test_banked_artifacts_attached_to_suite_output(monkeypatch):
     r5 = runs["r5_manual_suite_run1.json"]
     assert r5["tinyllama-bf16"]["value"] == 2727.11
     assert "TPU" in r5["llama3-8b-int8"]["device"]
+
+
+# ---------------------------------------------------------------------------
+# open-system serving row + the r6 probe-wedge fix
+# ---------------------------------------------------------------------------
+
+
+def test_suite_has_serving_open_row():
+    rows = {r["name"]: r for r in bench.SUITE_ROWS}
+    so = rows["serving-open"]
+    assert so["flags"][1] == "serve-open"
+    # the ladder shrinks the sweep, never abandons the open-system shape
+    assert all("--serve-open-requests" in rung or "--batch" in rung
+               for rung in so["ladder"])
+
+
+def test_serve_open_flags_in_help():
+    help_text = bench.build_parser().format_help()
+    for flag in ("--serve-open-qps", "--serve-open-requests",
+                 "--slo-ttft-ms", "--slo-tpot-ms"):
+        assert flag in help_text, f"{flag} missing from bench --help"
+    assert "serve-open" in help_text
+
+
+def test_no_hardware_skips_probe_and_banks_serving_fallbacks(monkeypatch):
+    """The r6 wedge fix: with no host-local TPU evidence the suite never
+    probes (libtpu's metadata retry storm burned the whole r03–r05 probe
+    budget on hosts with nothing to find), falls back in milliseconds,
+    and the CPU fallback now banks SERVING rows too — serving-cb/open had
+    never had an in-suite number on any backend."""
+    calls = []
+
+    def child(argv, timeout, env=None):
+        calls.append(list(argv))
+        assert "--probe" not in argv, "probed despite no hardware evidence"
+        if "serve-open" in argv:
+            return {"metric": "serving max QPS", "value": 3.2,
+                    "unit": "req/s@slo", "vs_baseline": 1.0, "detail": {}}, None
+        if "serve" in argv:
+            return {"metric": "serving tokens/sec/chip", "value": 30.0,
+                    "unit": "tokens/s/chip", "vs_baseline": 4.3,
+                    "detail": {}}, None
+        return _row(2.0), None
+
+    out = run_suite_with(monkeypatch, child, hardware=False)
+    probe = out["detail"]["probe"]
+    assert probe["attempts"] == [] and probe["tpu_ok"] is False
+    assert probe["hardware"]["present"] is False
+    rows = out["detail"]["rows"]
+    assert rows["tinyllama-bf16-cpu-fallback"]["value"] == 2.0
+    assert rows["serving-cb-cpu-fallback"]["value"] == 30.0
+    assert rows["serving-open-cpu-fallback"]["value"] == 3.2
+    # every fallback child was forced onto the CPU backend
+    assert all("cpu" in c[c.index("--backend") + 1] for c in calls)
+
+
+def test_mds_wedge_signature_triggers_skip_retry(monkeypatch):
+    """A probe failure carrying libtpu's metadata-retry-storm signature
+    makes the NEXT attempt run with TPU_SKIP_MDS_QUERY=1 — fail fast
+    with a named cause instead of burning the budget on 30x-retry URL
+    fetches."""
+    envs = []
+
+    def child(argv, timeout, env=None):
+        if "--probe" in argv:
+            envs.append(env)
+            if len(envs) == 1:
+                return None, ("timeout: Failed to get TPU metadata "
+                              "(tpu-env) ... 30 tries (http status: 403)")
+            return _probe_ok(), None
+        return _row(2700.0), None
+
+    out = run_suite_with(monkeypatch, child, rows="tinyllama-bf16",
+                         probe_retries=1, probe_timeout=600.0)
+    assert envs[0] is None
+    assert envs[1] == {"TPU_SKIP_MDS_QUERY": "1"}
+    attempts = out["detail"]["probe"]["attempts"]
+    assert attempts[0]["env"] is None and "metadata" in attempts[0]["error"]
+    assert attempts[1]["env"] == {"TPU_SKIP_MDS_QUERY": "1"}
+    assert out["detail"]["probe"]["tpu_ok"] is True
+    assert out["value"] == 2700.0
+
+
+def test_tpu_hardware_evidence_is_local_and_fast():
+    ev = bench._tpu_hardware_evidence()
+    assert set(ev) == {"dev_accel", "dev_vfio", "env", "present"}
+    assert isinstance(ev["present"], bool)
+    json.dumps(ev)
+
+
+def test_child_timeout_keeps_stderr_tail(monkeypatch):
+    """TimeoutExpired diagnosis: the child's dying stderr rides the error
+    string (the r03–r05 'timeout' told nothing; the storm signature was
+    in the killed child's output all along)."""
+    import subprocess
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(
+            cmd, kw.get("timeout"),
+            stderr=b"noise\nFailed to get TPU metadata (tpu-env) x\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    res, err = bench._child(["--probe"], timeout=1.0)
+    assert res is None
+    assert err.startswith("timeout:")
+    assert bench._MDS_WEDGE_SIGNATURE in err

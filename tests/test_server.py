@@ -1,0 +1,382 @@
+"""Open-system front-end tests (mdi_llm_tpu/server/): the acceptance
+contract — greedy token streams THROUGH the server are identical to the
+offline engine on the same trace, with zero post-warmup recompiles and
+bit-identical host syncs with the front-end attached — plus the fast CPU
+HTTP e2e: one SSE completion streamed end to end, 429 backpressure at
+the admission bound, graceful drain, and request cancellation."""
+
+import asyncio
+import http.client
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import init_params
+from mdi_llm_tpu.server import (
+    FrontendClosedError,
+    QueueFullError,
+    ServingFrontend,
+)
+from tests.test_model import tiny_config
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _trace(cfg, lengths=(3, 9, 17, 5), news=(8, 12, 6, 10), seed=5):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+               for n in lengths]
+    return list(zip([f"r{i}" for i in range(len(prompts))], prompts,
+                    list(news)))
+
+
+def _engine(gen, obs=None, policy=None, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch", 3)
+    kw.setdefault("prefill_chunk", 8)
+    return gen.serve(obs=obs, policy=policy, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance contract: server == offline engine, zero interference
+# ---------------------------------------------------------------------------
+
+
+def test_frontend_streams_match_offline_engine(served_model):
+    """Same trace, all submitted before the engine thread starts: every
+    per-request greedy stream, the host-sync count, and the compile set
+    are identical to `engine.run()` offline — the front-end adds threads
+    AROUND the loop, never inside it.  Holds under every policy (default
+    attributes make them all reduce to FCFS ordering)."""
+    from mdi_llm_tpu.serving.policy import make_policy
+    from mdi_llm_tpu.utils.profiling import CompileGuard
+
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    trace = _trace(cfg)
+
+    guard = CompileGuard(label="server-overhead")
+    with guard:
+        offline = _engine(gen)
+        for rid, p, m in trace:
+            offline.add_request(rid, p, m)
+        want, stats_off = offline.run()  # warmup: compiles allowed
+        guard.mark_warm()
+
+        for policy_name in (None, "priority", "fair", "deadline"):
+            engine = _engine(gen, policy=make_policy(policy_name))
+            front = ServingFrontend(engine)
+            handles = {rid: front.submit(p, m, rid=rid)
+                       for rid, p, m in trace}
+            front.start()
+            assert front.drain(timeout=300.0), "drain timed out"
+            front.stop()
+            for rid, p, _m in trace:
+                assert handles[rid].result == want[rid], \
+                    f"{rid} diverged under policy={policy_name}"
+                assert handles[rid].tokens == want[rid][len(p):], \
+                    f"{rid} streamed tokens diverged"
+            assert engine.stats.host_syncs == stats_off.host_syncs, \
+                "the front-end changed the sync cadence"
+            assert engine.stats.tokens_generated == stats_off.tokens_generated
+    guard.expect_clean()  # zero post-warmup recompiles, server attached
+
+
+def test_frontend_open_arrivals_complete(served_model):
+    """Requests submitted WHILE the engine is running (the open-system
+    case) are admitted via the step_hook seam and complete correctly."""
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    trace = _trace(cfg)
+    offline = _engine(gen)
+    for rid, p, m in trace:
+        offline.add_request(rid, p, m)
+    want, _ = offline.run()
+
+    engine = _engine(gen)
+    front = ServingFrontend(engine).start()
+    handles = {}
+    for rid, p, m in trace:
+        handles[rid] = front.submit(p, m, rid=rid)
+        # stagger arrivals into the running engine
+        handles[rid].done.wait(timeout=0.02)
+    assert front.drain(timeout=300.0)
+    front.stop()
+    for rid, p, _m in trace:
+        assert handles[rid].result == want[rid], f"{rid} diverged (open)"
+    assert front.idle
+
+
+def test_frontend_backpressure_and_stats(served_model):
+    """Arrivals past the admission bound raise QueueFullError BEFORE the
+    engine thread starts consuming; the rejection lands in the canonical
+    stats and the observer counter."""
+    from mdi_llm_tpu.obs import ServingObserver
+
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    obs = ServingObserver()
+    engine = _engine(gen, obs=obs)
+    front = ServingFrontend(engine, max_queue=2)  # engine NOT started:
+    # submissions pile in the channel deterministically
+    p = [1, 2, 3]
+    front.submit(p, 4, rid="a")
+    front.submit(p, 4, rid="b")
+    with pytest.raises(QueueFullError):
+        front.submit(p, 4, rid="c")
+    assert engine.stats.requests_rejected == 1
+    assert engine.stats.offered_qps > 0.0
+    d = engine.stats.to_dict()
+    assert d["requests_rejected"] == 1 and d["offered_qps"] > 0.0
+    c = obs.metrics.to_dict()["counters"]
+    assert c["serving_requests_rejected_total"] == 1
+    # infeasible request: synchronous ValueError (HTTP 400), NOT a 429
+    with pytest.raises(ValueError, match="exceeds max_seq_length"):
+        front.submit([1] * 100, 100, rid="huge")
+    # the two accepted requests still complete
+    front.start()
+    assert front.drain(timeout=300.0)
+    front.stop()
+    assert engine.stats.requests_finished == 2
+
+
+def test_frontend_rejects_after_drain_and_cancel(served_model):
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = _engine(gen)
+    front = ServingFrontend(engine).start()
+    h = front.submit([5, 6, 7], 12, rid="long")
+    assert front.cancel("long") is True
+    assert front.cancel("nope") is False
+    h.done.wait(timeout=60.0)
+    assert h.cancelled and h.result is None
+    front.drain(timeout=60.0)
+    with pytest.raises(FrontendClosedError):
+        front.submit([1, 2], 2, rid="late")
+    front.stop()
+
+
+def test_queue_depth_peak_rides_replay_stats(served_model):
+    """queue_depth_peak is an engine-side field: a replay run with more
+    requests than slots records the backlog high-water mark."""
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = _engine(gen, max_batch=1)
+    for rid, p, m in _trace(cfg):
+        engine.add_request(rid, p, m)
+    _results, stats = engine.run()
+    assert stats.queue_depth_peak >= 1
+    assert stats.to_dict()["queue_depth_peak"] == stats.queue_depth_peak
+    assert stats.to_dict()["offered_qps"] == 0.0  # replay: no open loop
+
+
+# ---------------------------------------------------------------------------
+# HTTP e2e (CPU-fast): SSE stream, 429, graceful drain
+# ---------------------------------------------------------------------------
+
+
+def _http(port, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _sse_events(raw: bytes):
+    events = []
+    for block in raw.decode().split("\n\n"):
+        if not block.strip():
+            continue
+        ev = {}
+        for line in block.splitlines():
+            k, _, v = line.partition(": ")
+            ev[k] = v
+        if "data" in ev:
+            ev["data"] = json.loads(ev["data"])
+        events.append(ev)
+    return events
+
+
+def test_http_server_e2e(served_model):
+    """The fast CPU e2e: start the HTTP server on an ephemeral port,
+    stream one SSE completion token-for-token against the offline
+    reference, exercise 429 backpressure with the engine stalled, then
+    drain gracefully — in-flight work finishes, late arrivals get
+    refused, and the whole session runs zero post-warmup recompiles."""
+    from mdi_llm_tpu.server.http import ServingHTTPServer
+    from mdi_llm_tpu.utils.profiling import CompileGuard
+
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    trace = _trace(cfg)
+    offline = _engine(gen)
+    for rid, p, m in trace:
+        offline.add_request(rid, p, m)
+    want, _ = offline.run()  # also the warmup for the compile guard
+
+    guard = CompileGuard(label="http-e2e")
+    with guard:
+        guard.mark_warm()
+        engine = _engine(gen)
+        front = ServingFrontend(engine, max_queue=16)
+        srv = ServingHTTPServer(front, port=0, drain_timeout_s=120.0)
+        results = {}
+
+        async def drive():
+            await srv.start()
+            loop = asyncio.get_running_loop()
+
+            def call(*a, **kw):
+                return loop.run_in_executor(None, lambda: _http(*a, **kw))
+
+            # health up
+            st, _h, body = await call(srv.port, "GET", "/healthz")
+            results["health"] = (st, json.loads(body))
+            # one SSE stream
+            rid, prompt, new = trace[0]
+            st, hdrs, raw = await call(
+                srv.port, "POST", "/v1/completions",
+                json.dumps({"prompt": prompt, "max_tokens": new,
+                            "stream": True}),
+            )
+            results["sse"] = (st, hdrs, _sse_events(raw))
+            # non-streaming JSON
+            rid2, prompt2, new2 = trace[1]
+            st, _h, body = await call(
+                srv.port, "POST", "/v1/completions",
+                json.dumps({"prompt": prompt2, "max_tokens": new2}),
+            )
+            results["json"] = (st, json.loads(body))
+            # malformed body → 400
+            st, _h, body = await call(
+                srv.port, "POST", "/v1/completions", "{not json")
+            results["bad"] = (st, json.loads(body))
+            # drain: in-flight finishes, server refuses new work and the
+            # listener closes
+            st, _h, _b = await call(srv.port, "GET", "/v1/stats")
+            results["stats_status"] = st
+            await srv.shutdown()
+
+        asyncio.run(drive())
+    guard.expect_clean()  # zero post-warmup recompiles, HTTP attached
+
+    st, health = results["health"]
+    assert st == 200 and health["status"] == "ok"
+    assert health["queue_bound"] == 16
+
+    st, hdrs, events = results["sse"]
+    assert st == 200
+    assert hdrs.get("Content-Type") == "text/event-stream"
+    token_evs = [e for e in events if e.get("event") == "token"]
+    done_evs = [e for e in events if e.get("event") == "done"]
+    rid, prompt, new = trace[0]
+    assert [e["data"]["token"] for e in token_evs] == want[rid][len(prompt):]
+    assert len(done_evs) == 1
+    assert done_evs[0]["data"]["tokens"] == want[rid][len(prompt):]
+    assert done_evs[0]["data"]["n_generated"] == len(want[rid]) - len(prompt)
+
+    st, body = results["json"]
+    rid2, prompt2, _new2 = trace[1]
+    assert st == 200 and body["tokens"] == want[rid2][len(prompt2):]
+
+    assert results["bad"][0] == 400
+    assert results["stats_status"] == 200
+    # post-shutdown: engine thread stopped, nothing leaked
+    assert front.idle
+
+
+def test_http_backpressure_429(served_model):
+    """With the engine thread NOT consuming, arrivals past the bound get
+    429 + Retry-After while earlier ones are still queued."""
+    from mdi_llm_tpu.server.http import ServingHTTPServer
+
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = _engine(gen)
+    front = ServingFrontend(engine, max_queue=1)
+    # start ONLY the HTTP listener — not the engine thread — so the
+    # first request parks in the channel deterministically
+    srv = ServingHTTPServer(front, port=0)
+    results = {}
+
+    async def drive():
+        # bypass srv.start()'s frontend auto-start: bind the listener
+        srv._loop = asyncio.get_running_loop()
+        srv._server = await asyncio.start_server(
+            srv._handle_conn, srv.host, srv.port)
+        srv.port = srv._server.sockets[0].getsockname()[1]
+        loop = asyncio.get_running_loop()
+
+        def call(*a, **kw):
+            return loop.run_in_executor(None, lambda: _http(*a, **kw))
+
+        # park one request in the channel directly (an HTTP submission
+        # would block its connection waiting on a completion the stopped
+        # engine never produces)
+        front.submit([1, 2, 3], 4, rid="parked")
+        st, hdrs, body = await call(
+            srv.port, "POST", "/v1/completions",
+            json.dumps({"prompt": [4, 5, 6], "max_tokens": 4}),
+        )
+        results["second"] = (st, hdrs, json.loads(body))
+        srv._server.close()
+        await srv._server.wait_closed()
+
+    asyncio.run(drive())
+    st, hdrs, body = results["second"]
+    assert st == 429
+    assert hdrs.get("Retry-After") == "1"
+    assert "admission queue full" in body["error"]
+    assert engine.stats.requests_rejected == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI surface + docs coverage
+# ---------------------------------------------------------------------------
+
+
+def test_server_cli_help_covers_new_flags():
+    from mdi_llm_tpu.cli.serve import build_parser as serve_parser
+    from mdi_llm_tpu.cli.server import build_parser as server_parser
+
+    server_help = " ".join(server_parser().format_help().split())
+    for flag in ("--host", "--port", "--admission-queue", "--drain-timeout",
+                 "--policy"):
+        assert flag in server_help, f"{flag} missing from mdi-server --help"
+    assert "429" in server_help  # backpressure semantics are documented
+    serve_help = " ".join(serve_parser().format_help().split())
+    assert "--policy" in serve_help
+    for policy in ("fcfs", "priority", "fair", "deadline"):
+        assert policy in serve_help
+
+
+def test_server_console_script_registered():
+    from pathlib import Path
+
+    # plain-text check (this interpreter build ships no tomllib)
+    text = (Path(__file__).resolve().parents[1] / "pyproject.toml").read_text()
+    assert 'mdi-server = "mdi_llm_tpu.cli.server:main"' in text
+
+
+def test_serving_docs_cover_http_api():
+    from pathlib import Path
+
+    doc = (Path(__file__).resolve().parents[1] / "docs" / "serving.md")
+    text = doc.read_text()
+    for needle in ("POST /v1/completions", "event: token", "event: done",
+                   "429", "Graceful drain", "serve-open",
+                   "bad-server-config", "ttft_slo_ms"):
+        assert needle in text, f"docs/serving.md missing {needle!r}"
