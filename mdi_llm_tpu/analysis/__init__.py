@@ -28,6 +28,7 @@ from mdi_llm_tpu.analysis.core import (  # noqa: F401
     lint_source,
 )
 import mdi_llm_tpu.analysis.rules  # noqa: E402,F401  (populates RULES)
+import mdi_llm_tpu.analysis.threads  # noqa: E402,F401  (thread-role rules)
 
 __all__ = [
     "Baseline", "Finding", "Rule", "RULES", "lint_paths", "lint_source",

@@ -369,6 +369,7 @@ class Baseline:
 
 def _selected_rules(select: Optional[Sequence[str]]) -> List[Rule]:
     import mdi_llm_tpu.analysis.rules  # noqa: F401  (registers RULES)
+    import mdi_llm_tpu.analysis.threads  # noqa: F401  (thread-role rules)
 
     if not select:
         return list(RULES.values())

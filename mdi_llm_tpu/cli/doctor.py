@@ -146,6 +146,18 @@ STAGES: List[Dict[str, Any]] = [
             " 'psum_correct': bool(float(out[0]) == n)}))\n"
         ),
     },
+    {
+        "name": "threads",
+        "help": "seeded schedule-explorer burst on a tiny CPU serving "
+                "engine (host concurrency-contract triage)",
+        "timeout": 300.0,
+        "quick": False,
+        "code": (
+            "import json\n"
+            "from mdi_llm_tpu.server.explorer import doctor_burst\n"
+            "print(json.dumps(doctor_burst()))\n"
+        ),
+    },
 ]
 
 

@@ -120,7 +120,7 @@ class ServingObserver:
         lifecycle hooks fired outside a step still get a timestamp)."""
         return self._now if self._now is not None else self.clock()
 
-    def step(self, kind: str, width: int, live: int,
+    def step(self, kind: str, width: int, live: int,  # mdi-thread: engine
              t_start: Optional[float] = None,
              kv_utilization: Optional[float] = None,
              queue_depth: Optional[int] = None,
@@ -157,7 +157,7 @@ class ServingObserver:
         self._maybe_sample_rss(now)
         return now
 
-    def _maybe_sample_rss(self, now: float) -> None:
+    def _maybe_sample_rss(self, now: float) -> None:  # mdi-thread: engine
         if self.rss_interval_s is None or self._rss_broken:
             return
         if (self._last_rss_ts is not None
@@ -179,13 +179,13 @@ class ServingObserver:
 
     # -- request lifecycle (scheduler/engine hooks) --------------------------
 
-    def request_submitted(self, rid: str, n_prompt: int,
+    def request_submitted(self, rid: str, n_prompt: int,  # mdi-thread: engine
                           max_new_tokens: int) -> None:
         self.tracer.request_submitted(rid, n_prompt, max_new_tokens)
         self.metrics.counter("serving_requests_submitted_total",
                              "requests queued").inc()
 
-    def request_admitted(self, rid: str, slot: int, admit_order: int,
+    def request_admitted(self, rid: str, slot: int, admit_order: int,  # mdi-thread: engine
                          n_cached: int = 0, resumed: bool = False) -> None:
         self.tracer.request_admitted(rid, slot, admit_order,
                                      n_cached=n_cached, resumed=resumed)
@@ -197,7 +197,7 @@ class ServingObserver:
                                  "prompt tokens served from the prefix "
                                  "cache").inc(n_cached)
 
-    def request_rejected(self, rid: str) -> None:
+    def request_rejected(self, rid: str) -> None:  # mdi-thread: any
         """Open-system backpressure: an arrival bounced at the admission
         queue bound (server/frontend.py → HTTP 429).  Counter only — a
         rejected request never opens a timing record, so the latency
@@ -207,22 +207,22 @@ class ServingObserver:
                              "arrivals rejected by admission "
                              "backpressure").inc()
 
-    def request_preempted(self, rid: str, n_generated: int) -> None:
+    def request_preempted(self, rid: str, n_generated: int) -> None:  # mdi-thread: engine
         self.tracer.request_preempted(rid, n_generated)
         self.metrics.counter("serving_preemptions_total",
                              "recompute-style preemptions").inc()
 
-    def prefill_chunk(self, rid: str, n_tokens: int) -> None:
+    def prefill_chunk(self, rid: str, n_tokens: int) -> None:  # mdi-thread: engine
         self.tracer.prefill_chunk(rid, n_tokens, self.now)
         self.metrics.counter("serving_prefill_tokens_total",
                              "prompt tokens fed").inc(n_tokens)
 
-    def tokens(self, rid: str, n: int = 1) -> None:
+    def tokens(self, rid: str, n: int = 1) -> None:  # mdi-thread: engine
         self.tracer.tokens(rid, n, self.now)
         self.metrics.counter("serving_tokens_generated_total",
                              "tokens emitted to streams").inc(n)
 
-    def request_finished(self, rid: str) -> None:
+    def request_finished(self, rid: str) -> None:  # mdi-thread: engine
         self.tracer.request_finished(rid, self.now)
         self.metrics.counter("serving_requests_finished_total",
                              "requests retired complete").inc()

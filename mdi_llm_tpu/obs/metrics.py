@@ -23,6 +23,7 @@ buckets, `_sum`/`_count`, the `+Inf` bucket always present).
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -45,27 +46,36 @@ LATENCY_BUCKETS_S: Tuple[float, ...] = (
 
 
 class Counter:
-    """Monotonically increasing count (requests, tokens, compiles)."""
+    """Monotonically increasing count (requests, tokens, compiles).
+
+    Mutations take an internal lock: counters cross the thread seam —
+    e.g. `requests_rejected` increments on the submitting thread while
+    the engine thread bumps token counters — and `self.value += n` is a
+    read-modify-write that would lose updates (mdi-race audit, PR 13).
+    Reading `value` is a single GIL-atomic load and stays lock-free."""
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
         self.value: float = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: float = 1) -> None:
         if n < 0:
             raise ValueError(f"counter {self.name} cannot decrease (inc {n})")
-        self.value += n
+        with self._lock:
+            self.value += n
 
     def set_to(self, v: float) -> None:
         """Advance to an externally-maintained running total (the engine's
         `ServingStats` aggregates) — still monotonic, never backwards."""
-        if v < self.value:
-            raise ValueError(
-                f"counter {self.name} cannot move backwards "
-                f"({self.value} -> {v})"
-            )
-        self.value = v
+        with self._lock:
+            if v < self.value:
+                raise ValueError(
+                    f"counter {self.name} cannot move backwards "
+                    f"({self.value} -> {v})"
+                )
+            self.value = v
 
 
 class Gauge:
@@ -75,9 +85,11 @@ class Gauge:
         self.name = name
         self.help = help
         self.value: float = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        with self._lock:
+            self.value = float(v)
 
 
 class Histogram:
@@ -98,25 +110,29 @@ class Histogram:
         self.counts: List[int] = [0] * (len(self.bounds) + 1)  # +overflow
         self.sum: float = 0.0
         self.count: int = 0
+        self._lock = threading.Lock()  # observe is a multi-field RMW
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self.sum += v
-        self.count += 1
-        for i, b in enumerate(self.bounds):
-            if v <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
 
     def cumulative(self) -> List[Tuple[float, int]]:
         """[(le, cumulative_count), ...] ending with (+inf, count)."""
         out: List[Tuple[float, int]] = []
         acc = 0
-        for b, c in zip(self.bounds, self.counts):
+        with self._lock:
+            counts, total = list(self.counts), self.count
+        for b, c in zip(self.bounds, counts):
             acc += c
             out.append((b, acc))
-        out.append((math.inf, self.count))
+        out.append((math.inf, total))
         return out
 
     def percentile(self, q: float) -> float:
@@ -125,12 +141,14 @@ class Histogram:
         first; the overflow bucket reports its lower bound)."""
         if not 0 <= q <= 100:
             raise ValueError("q must be in [0, 100]")
-        if self.count == 0:
+        with self._lock:
+            counts, total = list(self.counts), self.count
+        if total == 0:
             return 0.0
-        rank = q / 100.0 * self.count
+        rank = q / 100.0 * total
         acc = 0
         lo = 0.0
-        for b, c in zip(self.bounds, self.counts):
+        for b, c in zip(self.bounds, counts):
             if acc + c >= rank and c > 0:
                 frac = (rank - acc) / c
                 return lo + (b - lo) * min(1.0, max(0.0, frac))
@@ -185,18 +203,20 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._metrics: "Dict[str, object]" = {}
+        self._lock = threading.Lock()  # get-or-create races across threads
 
     def _get(self, cls, name: str, help: str, **kw):
-        m = self._metrics.get(name)
-        if m is None:
-            m = cls(name, help, **kw)
-            self._metrics[name] = m
-        elif not isinstance(m, cls):
-            raise TypeError(
-                f"metric {name!r} already registered as "
-                f"{type(m).__name__}, not {cls.__name__}"
-            )
-        return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
 
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get(Counter, name, help)

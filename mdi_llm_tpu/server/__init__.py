@@ -17,8 +17,17 @@ Turns the replay-a-trace-and-exit `ServingEngine` into a live system
 - `loadgen` — open-loop arrival generation (Poisson, replayed-trace)
   and the offered-load sweep that finds the max QPS meeting a p99
   TTFT/TPOT SLO (`bench.py --mode serve-open`).
+- `explorer` — mdi-race's deterministic schedule explorer: seeded
+  adversarial interleavings through the frontend's yield points, with
+  offline-replay token parity as the oracle (docs/analysis.md
+  "Concurrency analysis").
 """
 
+from mdi_llm_tpu.server.explorer import (
+    ScheduleExplorer,
+    doctor_burst,
+    run_episode,
+)
 from mdi_llm_tpu.server.frontend import (
     FrontendClosedError,
     QueueFullError,
@@ -39,8 +48,11 @@ __all__ = [
     "OpenLoopRunner",
     "QueueFullError",
     "RequestHandle",
+    "ScheduleExplorer",
     "ServingFrontend",
+    "doctor_burst",
     "poisson_arrivals",
     "replay_arrivals",
+    "run_episode",
     "sweep_offered_load",
 ]

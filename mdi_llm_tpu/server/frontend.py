@@ -29,6 +29,14 @@ Lifecycle: `start()` → serve → `drain()` (stop accepting, let in-flight
 finish) → `stop()` (join; with `hard=True` abort the loop at the next
 step boundary).  `cancel(rid)` retires a live request at the next step
 boundary and completes its handle with the tokens emitted so far.
+
+Concurrency contract (checked statically by mdi-lint's thread rules,
+see docs/analysis.md "Concurrency analysis"): every mutable attribute
+shared between the engine thread and submitters is touched only under
+`self._lock`.  The `_yield_point()` calls below are mdi-race's seams —
+no-ops in production (one global read), but the deterministic schedule
+explorer (`server/explorer.py`) installs a seeded scheduler there to
+force adversarial interleavings in tests.
 """
 
 from __future__ import annotations
@@ -43,6 +51,18 @@ __all__ = [
     "RequestHandle",
     "ServingFrontend",
 ]
+
+#: mdi-race hook: tests install a callable via
+#: `server.explorer.ScheduleExplorer.install()`; production never does.
+_YIELD: Optional[Callable[[str], None]] = None
+
+
+def _yield_point(tag: str) -> None:
+    """A named interleaving seam.  With no explorer installed this is a
+    single global load — zero overhead on the serving path."""
+    y = _YIELD
+    if y is not None:
+        y(tag)
 
 
 class QueueFullError(RuntimeError):
@@ -81,30 +101,36 @@ class RequestHandle:
         self.submitted_s = time.perf_counter()
         self._sink = sink
 
-    def _event(self, kind: str, payload) -> None:
+    def _event(self, kind: str, payload) -> None:  # mdi-thread: engine
         if self._sink is not None:
             self._sink((kind, payload))
 
-    def _on_token(self, tok: int) -> None:
+    def _on_token(self, tok: int) -> None:  # mdi-thread: engine
+        # single writer (engine thread); mid-flight readers get a
+        # GIL-atomic snapshot of streaming progress by design
+        # mdi-lint: disable-next-line=unguarded-shared-state -- lock-free by design, see above
         self.tokens.append(tok)
         self._event("token", tok)
 
-    def _complete(self, result: List[int]) -> None:
+    def _complete(self, result: List[int]) -> None:  # mdi-thread: engine
+        # written once, strictly before done.set(): Event.set()/wait()
+        # is the publication barrier readers synchronize on
+        # mdi-lint: disable-next-line=unguarded-shared-state -- published via done Event, see above
         self.result = result
         self._event("done", result)
         self.done.set()
 
-    def _cancel(self) -> None:
+    def _cancel(self) -> None:  # mdi-thread: engine
         self.cancelled = True
         self._event("cancelled", list(self.tokens))
         self.done.set()
 
-    def _fail(self, msg: str) -> None:
+    def _fail(self, msg: str) -> None:  # mdi-thread: engine
         self.error = msg
         self._event("error", msg)
         self.done.set()
 
-    def generated(self) -> List[int]:
+    def generated(self) -> List[int]:  # mdi-thread: any
         """Kept generated tokens: the stop-trimmed result suffix once
         finished, else the stream so far."""
         if self.result is not None:
@@ -163,7 +189,10 @@ class ServingFrontend:
         """Accepted-but-not-yet-seated requests: the submission channel
         plus the scheduler's waiting queue.  `len()` on both is a GIL
         atomic read and the count is only used for admission control, so
-        a stale-by-one view is acceptable by design."""
+        a stale-by-one view is acceptable by design.  MUST stay lock-free:
+        `submit()` calls it while already holding the non-reentrant
+        `self._lock`."""
+        # mdi-lint: disable-next-line=unguarded-shared-state -- GIL-atomic len(); locking here would deadlock submit()
         return len(self._channel) + len(self.engine.scheduler.waiting)
 
     def submit(
@@ -185,7 +214,16 @@ class ServingFrontend:
         from mdi_llm_tpu.serving.scheduler import Request
 
         prompt = [int(t) for t in prompt]
+        _yield_point("submit:enter")
         with self._lock:
+            # the closed check comes FIRST: an arrival that loses the
+            # race with drain() gets a deterministic 503 with zero side
+            # effects — it is not offered load against a closed server
+            # (pinned by the drain-window explorer seeds)
+            if self._draining or self._stopped:
+                raise FrontendClosedError(
+                    "frontend is draining/stopped; not accepting requests"
+                )
             now = time.perf_counter()
             if self._t_first is None:
                 self._t_first = now
@@ -197,10 +235,6 @@ class ServingFrontend:
             self.engine.stats.offered_qps = (
                 self._offered / elapsed if self._offered > 1 else 0.0
             )
-            if self._draining or self._stopped:
-                raise FrontendClosedError(
-                    "frontend is draining/stopped; not accepting requests"
-                )
             if rid is None:
                 rid = f"req{self._rid_counter}"
                 self._rid_counter += 1
@@ -227,6 +261,7 @@ class ServingFrontend:
                                    sink=sink)
             self._handles[rid] = handle
             self._channel.append((handle, req))
+        _yield_point("submit:queued")
         self._wake.set()
         return handle
 
@@ -235,10 +270,12 @@ class ServingFrontend:
         before admission, live ones retire at the next step boundary,
         keeping the tokens already generated.  Returns False for unknown/
         finished rids.  The handle completes via its "cancelled" event."""
+        _yield_point("cancel:enter")
         with self._lock:
             if rid not in self._handles:
                 return False
             self._cancels.append(rid)
+        _yield_point("cancel:queued")
         self._wake.set()
         return True
 
@@ -256,18 +293,21 @@ class ServingFrontend:
     @property
     def idle(self) -> bool:
         """No channel entries, no scheduler work, no live handles."""
-        return (
-            not self._channel
-            and not self.engine.scheduler.has_work
-            and not self._handles
-        )
+        with self._lock:
+            return (
+                not self._channel
+                and not self.engine.scheduler.has_work
+                and not self._handles
+            )
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful drain: stop accepting (submit → FrontendClosedError),
         let everything in flight finish.  Returns True when idle within
         `timeout` (None = wait forever)."""
+        _yield_point("drain:enter")
         with self._lock:
             self._draining = True
+        _yield_point("drain:flagged")
         self._wake.set()
         deadline = None if timeout is None else time.perf_counter() + timeout
         while not self.idle:
@@ -283,6 +323,7 @@ class ServingFrontend:
         boundary, failing unfinished handles; the default lets the
         current `run()` finish its queue first (call `drain()` before
         `stop()` for a clean shutdown)."""
+        _yield_point("stop:enter")
         with self._lock:
             self._stopped = True
             self._draining = True
@@ -298,6 +339,7 @@ class ServingFrontend:
         channel entry was validated at submit time, so add() can only
         fail on a racing geometry change — fail the handle, not the
         loop."""
+        _yield_point("engine:drain-channel")
         with self._lock:
             batch, self._channel = self._channel, []
         for handle, req in batch:
@@ -310,13 +352,17 @@ class ServingFrontend:
 
     def _apply_cancels(self) -> None:
         """ENGINE THREAD: drop queued / retire live cancelled requests."""
+        _yield_point("engine:cancels")
         with self._lock:
             cancels, self._cancels = self._cancels, []
+            # snapshot the handles in the same critical section as the
+            # swap: a lone `_handles.get` outside it races submit/collect
+            handles = {rid: self._handles.get(rid) for rid in cancels}
         if not cancels:
             return
         sched = self.engine.scheduler
         for rid in cancels:
-            handle = self._handles.get(rid)
+            handle = handles.get(rid)
             if handle is None:
                 continue
             # not yet handed over: drop from the channel
@@ -346,6 +392,7 @@ class ServingFrontend:
 
     def _collect_finished(self) -> None:
         """ENGINE THREAD: complete handles whose requests retired."""
+        _yield_point("engine:collect")
         with self._lock:
             live = list(self._handles.items())
         for rid, handle in live:
@@ -359,7 +406,9 @@ class ServingFrontend:
         self.engine.scheduler.finished.clear()
 
     def _on_token(self, rid: str, tok: int) -> None:
-        handle = self._handles.get(rid)
+        _yield_point("engine:token")
+        with self._lock:
+            handle = self._handles.get(rid)
         if handle is not None:
             handle._on_token(tok)
 
@@ -370,7 +419,9 @@ class ServingFrontend:
         self._apply_cancels()
         self._drain_channel()
         self._collect_finished()
-        if self._hard_stop:
+        with self._lock:
+            hard = self._hard_stop
+        if hard:
             raise _HardStop
 
     def _pump(self) -> None:

@@ -353,7 +353,7 @@ class ServingHTTPServer:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
-        def sink(event):  # ENGINE thread
+        def sink(event):  # mdi-thread: engine
             loop.call_soon_threadsafe(q.put_nowait, event)
 
         handle, _ = self._submit(kwargs, sink=sink)

@@ -840,7 +840,7 @@ class ServingEngine:
         ):
             self._finish(seq)
 
-    def pop_result(self, rid: str) -> Optional[List[int]]:
+    def pop_result(self, rid: str) -> Optional[List[int]]:  # mdi-thread: engine
         """Take one finished request's token list (prompt + generation,
         stop-trimmed) out of the engine, or None if it has not finished.
         The open-system front-end (`server/frontend.py`) collects results
@@ -1168,7 +1168,7 @@ class ServingEngine:
         self.stats.decode_s += time.perf_counter() - t0
         return True
 
-    def step(self) -> bool:
+    def step(self) -> bool:  # mdi-thread: engine
         """Run one scheduler action; False when nothing was runnable.
 
         Any pending prefill work rides the unified mixed step together
@@ -1193,7 +1193,7 @@ class ServingEngine:
             self._run_decode(action[1])
         return True
 
-    def run(self, stream_cb=None,
+    def run(self, stream_cb=None,  # mdi-thread: engine
             step_hook=None) -> Tuple[Dict[str, List[int]], ServingStats]:
         """Drive the loop until every queued request finishes.  Returns
         {rid: full token list (prompt + generation, stop-trimmed)} — the

@@ -132,7 +132,7 @@ class Scheduler:
 
     # -- queue ---------------------------------------------------------------
 
-    def validate(self, req: Request) -> None:
+    def validate(self, req: Request) -> None:  # mdi-thread: any
         """The add-time feasibility wall, callable WITHOUT mutating any
         scheduler state: pure reads of pool/window constants, so the
         open-system front-end can pre-check a submission from its own
@@ -158,7 +158,7 @@ class Scheduler:
                 f"blocks, pool has {self.pool.num_blocks - 1}"
             )
 
-    def add(self, req: Request) -> None:
+    def add(self, req: Request) -> None:  # mdi-thread: engine
         self.validate(req)
         self.policy.on_submitted(req)  # stamps arrival_s for deadlines
         self.waiting.append(req)
@@ -168,18 +168,18 @@ class Scheduler:
             )
 
     @property
-    def has_work(self) -> bool:
+    def has_work(self) -> bool:  # mdi-thread: engine
         return bool(
             self.waiting or self.preempted
             or any(s is not None for s in self.slots)
         )
 
-    def running(self) -> List[SequenceState]:
+    def running(self) -> List[SequenceState]:  # mdi-thread: engine
         return [s for s in self.slots if s is not None]
 
     # -- admission -----------------------------------------------------------
 
-    def _free_slot(self) -> Optional[int]:
+    def _free_slot(self) -> Optional[int]:  # mdi-thread: engine
         for i, s in enumerate(self.slots):
             if s is None:
                 return i
@@ -211,7 +211,7 @@ class Scheduler:
             )
         return seq
 
-    def admit(self) -> List[SequenceState]:
+    def admit(self) -> List[SequenceState]:  # mdi-thread: engine
         """Policy-ordered admission, preempted sequences first (they hold
         progress the pool already paid for once, whatever the policy).
         Admission stops at the first pick that does not fit — the policy's
@@ -240,7 +240,7 @@ class Scheduler:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def retire(self, seq: SequenceState) -> None:
+    def retire(self, seq: SequenceState) -> None:  # mdi-thread: engine
         """Mid-batch retirement: free the slot and the blocks (copy-free —
         prefix-registered blocks stay warm in the pool's cached set)."""
         seq.done = True
@@ -252,7 +252,7 @@ class Scheduler:
         if self.observer is not None:
             self.observer.request_finished(seq.req.rid)
 
-    def preempt_latest(self, exclude: Optional[SequenceState] = None) -> bool:
+    def preempt_latest(self, exclude: Optional[SequenceState] = None) -> bool:  # mdi-thread: engine
         """Recompute-style preemption: kick the most recently admitted
         sequence back to the queue (its tokens re-prefill on resume)."""
         victims = [s for s in self.running() if s is not exclude]
@@ -277,7 +277,7 @@ class Scheduler:
             self.observer.request_preempted(seq.req.rid, seq.n_generated)
         return True
 
-    def ensure_blocks_for(self, seq: SequenceState, n_writes: int = 1) -> bool:
+    def ensure_blocks_for(self, seq: SequenceState, n_writes: int = 1) -> bool:  # mdi-thread: engine
         """Grow a decoding sequence's table to cover its next `n_writes`
         positions (`fed .. fed+n_writes-1` — a K-step decode chunk or a
         speculative verify's K+1 tokens), one block at a time; preempt
@@ -302,10 +302,10 @@ class Scheduler:
         return True
 
     # back-compat alias (the per-step decode path reserves one write)
-    def ensure_block_for(self, seq: SequenceState) -> bool:
+    def ensure_block_for(self, seq: SequenceState) -> bool:  # mdi-thread: engine
         return self.ensure_blocks_for(seq, 1)
 
-    def try_reserve(self, seq: SequenceState, n_writes: int) -> bool:
+    def try_reserve(self, seq: SequenceState, n_writes: int) -> bool:  # mdi-thread: engine
         """Non-preempting variant of `ensure_blocks_for`, for reservations
         made while a dispatched chunk is still in flight (double-buffering):
         preempting here would free blocks the device is actively writing.
@@ -324,7 +324,7 @@ class Scheduler:
 
     # -- action selection ----------------------------------------------------
 
-    def next_batch(self, token_budget: int):
+    def next_batch(self, token_budget: int):  # mdi-thread: engine
         """One step of the continuous-batching policy: admit whatever fits,
         then compose the step's token batch under `token_budget` — decode
         lanes FIRST (one pending token each, so a long prompt can never

@@ -120,6 +120,18 @@ def test_quick_triage_healthy_on_cpu(tmp_path, capsys):
     assert snap["stages"][2]["detail"]["correct"] is True
 
 
+def test_threads_stage_runs_on_cpu():
+    """The mdi-race stage end-to-end: a real subprocess runs the seeded
+    explorer burst against a tiny CPU engine and reports parity-clean."""
+    stage = next(s for s in doctor.STAGES if s["name"] == "threads")
+    assert stage["quick"] is False  # too heavy for --quick triage
+    rec = doctor.run_stage(stage)
+    assert rec["status"] == "ok", rec
+    assert rec["detail"]["ok"] is True
+    assert rec["detail"]["mismatches"] == []
+    assert rec["detail"]["yield_point_visits"] > 0
+
+
 def test_unhealthy_snapshot_exits_nonzero(monkeypatch, capsys):
     monkeypatch.setattr(
         doctor, "STAGES", [_stage("boom", "raise SystemExit(3)")]
@@ -145,7 +157,7 @@ def test_cli_surface():
     # the stage list is what --help/--list-stages document; pin the order
     names = [s["name"] for s in doctor.STAGES]
     assert names == ["import_jax", "devices", "matmul", "donation",
-                     "profiler_trace", "collective"]
+                     "profiler_trace", "collective", "threads"]
     assert [s["name"] for s in doctor.STAGES if s["quick"]] == [
         "import_jax", "devices", "matmul",
     ]

@@ -1,0 +1,283 @@
+"""mdi-race dynamic side (`server/explorer.py`): seeded adversarial
+interleavings of submit/cancel/drain/stop against a live CPU engine,
+with offline-replay token parity as the oracle.
+
+Four layers, matching the static rules' claims in docs/analysis.md:
+
+- explorer mechanics: per-seed determinism, single-installation guard,
+  production no-op.
+- the acceptance gate: 200 seeded pre-start interleavings whose token
+  streams, host-sync counts and compile set are identical to offline
+  `engine.run()` — the zero-interference contract under schedule
+  pressure (test_server.py pins the quiet-path version).
+- live adversarial episodes: mid-run submits, cancels of queued and
+  running requests, drains racing arrivals — invariants, not equality.
+- detector-detects: a deliberately-broken frontend (unlocked channel
+  hand-off) whose lost-update the explorer must catch, proving the
+  oracle has teeth; plus the drain-window regression seeds pinning the
+  submit-vs-drain fix in `frontend.submit`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import init_params
+from mdi_llm_tpu.server import (
+    FrontendClosedError,
+    ScheduleExplorer,
+    ServingFrontend,
+    run_episode,
+)
+from mdi_llm_tpu.server import frontend as frontend_mod
+from mdi_llm_tpu.utils.profiling import CompileGuard
+from tests.test_model import tiny_config
+
+#: the acceptance criterion: >= 200 seeded interleavings, parity-clean
+PARITY_SEEDS = range(200)
+
+#: live-engine episodes with cancels and racing drains (invariant suite)
+ADVERSARIAL_SEEDS = range(24)
+
+#: drain-window regression fixtures: on the reference box these seeds
+#: land arrivals on BOTH sides of the drain flag (some accepted, some
+#: 503), the pressure pattern that exposed the original half-admit bug
+#: where submit() bumped offered-load stats before the closed check.
+#: The invariant asserted below holds wherever each arrival lands, so
+#: the test stays sound on hosts whose scheduler times the race
+#: differently.
+DRAIN_REGRESSION_SEEDS = (20, 21, 24, 26, 31, 39, 44, 45, 56, 58)
+
+#: seeds for the deliberately-broken frontend; at least one must catch
+#: the planted lost-update (on the reference box three of six do)
+DETECTOR_SEEDS = range(6)
+
+
+@pytest.fixture(scope="module")
+def harness():
+    """Shared model + trace + offline oracle (one compile for the module:
+    `Generator` caches the compiled serving phases across engines)."""
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    # three requests, all seatable at once (max_batch=3): host-sync
+    # parity then holds for EVERY admission order — verified by running
+    # the offline engine over all six permutations — so multi-threaded
+    # pre-start submission may scramble the channel freely
+    trace = [(f"r{i}", rng.integers(1, cfg.vocab_size, n).tolist(), m)
+             for i, (n, m) in enumerate([(3, 8), (7, 12), (5, 6)])]
+
+    def engine():
+        return gen.serve(block_size=4, max_batch=3, prefill_chunk=8)
+
+    offline = engine()
+    for rid, p, m in trace:
+        offline.add_request(rid, p, m)
+    want, stats_off = offline.run()
+    return {"gen": gen, "cfg": cfg, "trace": trace, "engine": engine,
+            "want": want, "stats_off": stats_off}
+
+
+# ---------------------------------------------------------------------------
+# explorer mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_explorer_is_deterministic_per_seed():
+    a = ScheduleExplorer(7, record=True)
+    b = ScheduleExplorer(7, record=True)
+    for tag in ("submit:enter", "engine:collect", "drain:flagged", "t"):
+        a.visit(tag)
+        b.visit(tag)
+    assert a.visits == b.visits == 4
+    assert [t for _, t in a.trace] == [t for _, t in b.trace]
+    # a different seed draws a different perturbation stream
+    c = ScheduleExplorer(8)
+    assert c._rng.random() != ScheduleExplorer(7)._rng.random()
+
+
+def test_single_installation_is_enforced_and_uninstalled_on_exit():
+    assert frontend_mod._YIELD is None, "production default: no explorer"
+    frontend_mod._yield_point("anything")  # no-op, must not raise
+    with ScheduleExplorer(1) as a:
+        assert frontend_mod._YIELD == a.visit  # bound methods: ==, not is
+        with pytest.raises(RuntimeError):
+            ScheduleExplorer(2).install()
+    assert frontend_mod._YIELD is None, "context exit uninstalls"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: 200 seeds, parity with offline, zero recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_200_seeded_interleavings_match_offline(harness):
+    """Every seed perturbs the submit/wake/drain/stop interleaving and
+    the pre-start channel order; token streams, host-sync counts and the
+    compile set must not notice."""
+    want, stats_off = harness["want"], harness["stats_off"]
+    visits = 0
+    guard = CompileGuard(label="mdi-race-parity")
+    with guard:
+        guard.mark_warm()  # the fixture's offline run was the warmup
+        for seed in PARITY_SEEDS:
+            ep = run_episode(harness["engine"](), harness["trace"], seed,
+                             live=False)
+            assert ep["errors"] == {}, (seed, ep["errors"])
+            assert ep["drained"], f"seed {seed}: drain timed out"
+            for rid, p, _m in harness["trace"]:
+                h = ep["handles"][rid]
+                assert h.result == want[rid], f"seed {seed}: {rid} diverged"
+                assert h.tokens == want[rid][len(p):], \
+                    f"seed {seed}: {rid} streamed tokens diverged"
+            engine = ep["frontend"].engine
+            assert engine.stats.host_syncs == stats_off.host_syncs, \
+                f"seed {seed}: sync cadence changed under schedule pressure"
+            assert ep["frontend"].idle
+            assert engine.scheduler.finished == [], \
+                "long-lived server must not accumulate finished bookkeeping"
+            visits += ep["explorer"].visits
+    guard.expect_clean()  # zero post-warmup recompiles across all seeds
+    assert visits > len(PARITY_SEEDS) * 10, \
+        "the explorer must actually be perturbing the yield points"
+
+
+# ---------------------------------------------------------------------------
+# live adversarial episodes: cancels + racing drains (invariants)
+# ---------------------------------------------------------------------------
+
+
+def test_live_adversarial_episodes_hold_invariants(harness):
+    """Submitters race the running engine, a canceller kills queued and
+    live requests, and every third seed adds a drain racing the
+    arrivals.  Step composition now differs from the replay, so the
+    claims are per-request: greedy per-lane decode is composition-
+    independent, every handle completes exactly once, rejections are
+    deterministic 503s, and the frontend lands idle."""
+    want = harness["want"]
+    for seed in ADVERSARIAL_SEEDS:
+        cancel = ("r1",) if seed % 2 else ("r0", "r2")
+        ep = run_episode(harness["engine"](), harness["trace"], seed,
+                         live=True, cancel=cancel,
+                         drain_race=(seed % 3 == 0))
+        assert ep["drained"], f"seed {seed}: drain timed out"
+        for rid, p, _m in harness["trace"]:
+            if rid in ep["errors"]:
+                assert isinstance(ep["errors"][rid], FrontendClosedError), \
+                    f"seed {seed}: {rid} rejected with the wrong error"
+                assert rid not in ep["handles"]
+                continue
+            h = ep["handles"][rid]
+            assert h.done.is_set(), f"seed {seed}: {rid} never completed"
+            assert h.error is None, f"seed {seed}: {rid}: {h.error}"
+            if h.cancelled:
+                # retired at a step boundary with the tokens so far: a
+                # prefix of the offline stream, never garbage
+                gen_want = want[rid][len(p):]
+                assert h.tokens == gen_want[:len(h.tokens)], \
+                    f"seed {seed}: cancelled {rid} streamed wrong tokens"
+                assert rid in cancel
+            else:
+                assert h.result == want[rid], \
+                    f"seed {seed}: {rid} diverged (live)"
+        front = ep["frontend"]
+        assert front.idle, f"seed {seed}: frontend not idle after episode"
+        assert front.engine.scheduler.finished == []
+
+
+# ---------------------------------------------------------------------------
+# the drain window: arrivals racing drain() get a deterministic 503
+# ---------------------------------------------------------------------------
+
+
+def test_drained_frontend_rejects_with_zero_side_effects(harness):
+    """The deterministic half of the drain-window fix: a submit after
+    drain() raises FrontendClosedError BEFORE touching any stats — a
+    rejected arrival is not offered load against a closed server."""
+    engine = harness["engine"]()
+    front = ServingFrontend(engine).start()
+    assert front.drain(timeout=60.0)
+    with pytest.raises(FrontendClosedError):
+        front.submit([1, 2, 3], 4, rid="late")
+    assert front._offered == 0, "the 503 path must not count the arrival"
+    assert engine.stats.offered_qps == 0.0
+    assert "late" not in front._handles
+    front.stop()
+
+
+def test_drain_window_regression_seeds(harness):
+    """The racing half, pinned by explorer seeds: with a drain thread
+    racing the submitters, every arrival either completes with the
+    offline stream or raises FrontendClosedError — never hangs, never
+    half-admits — and the offered-load stats count exactly the accepted
+    side of the race."""
+    want, trace = harness["want"], harness["trace"]
+    for seed in DRAIN_REGRESSION_SEEDS:
+        ep = run_episode(harness["engine"](), trace, seed,
+                         live=True, drain_race=True)
+        assert ep["drained"], f"seed {seed}: drain timed out"
+        accepted, rejected = set(ep["handles"]), set(ep["errors"])
+        assert accepted | rejected == {rid for rid, _, _ in trace}
+        assert not (accepted & rejected), "half-admitted request"
+        for rid in rejected:
+            assert isinstance(ep["errors"][rid], FrontendClosedError)
+        for rid, _p, _m in trace:
+            if rid in accepted:
+                h = ep["handles"][rid]
+                assert h.done.is_set() and h.result == want[rid], \
+                    f"seed {seed}: accepted {rid} did not finish cleanly"
+        assert ep["frontend"]._offered == len(accepted), \
+            "rejected arrivals leaked into the offered-load stats"
+
+
+# ---------------------------------------------------------------------------
+# detector-detects: a planted lost-update the explorer must catch
+# ---------------------------------------------------------------------------
+
+
+class RacyFrontend(ServingFrontend):
+    """Deliberately broken: the channel hand-off snapshots and clears
+    WITHOUT the lock, re-creating the classic check-then-act lost
+    update.  A submit whose append lands in the window between `list()`
+    and `clear()` is silently dropped — its handle never completes, so
+    the episode's drain times out.  The `racy:window` yield point lets
+    the explorer hold the window open."""
+
+    def _drain_channel(self):
+        frontend_mod._yield_point("engine:drain-channel")
+        batch = list(self._channel)  # racy snapshot (no lock)
+        frontend_mod._yield_point("racy:window")
+        self._channel.clear()  # lost-update window closes here
+        for _handle, req in batch:
+            self.engine.scheduler.add(req)
+
+
+def test_explorer_catches_the_planted_lost_update(harness):
+    """The explorer suite is only evidence if it can FAIL: against the
+    broken frontend, at least one seed must lose a request and surface
+    it as a drain timeout + a failed handle."""
+    rng = np.random.default_rng(11)
+    cfg = harness["cfg"]
+    trace = [(f"x{i}", rng.integers(1, cfg.vocab_size, 4).tolist(), 4)
+             for i in range(6)]
+    detections = []
+    for seed in DETECTOR_SEEDS:
+        # p_pause=1.0: sleep at EVERY yield point, holding the racy
+        # window open for up to 4ms while the submitters keep arriving
+        ep = run_episode(harness["engine"](), trace, seed, live=True,
+                         frontend_cls=RacyFrontend, drain_timeout_s=0.75,
+                         explorer_kwargs={"p_pause": 1.0,
+                                          "max_pause_s": 0.004})
+        if ep["drained"]:
+            continue
+        lost = [rid for rid, h in ep["handles"].items()
+                if h.error == "frontend stopped before completion"]
+        assert lost, f"seed {seed}: undrained but no handle reports the loss"
+        detections.append((seed, lost))
+    assert detections, (
+        "no seed caught the planted lost-update: the explorer has "
+        "stopped exercising the channel hand-off race"
+    )

@@ -141,6 +141,66 @@ def fused_kernel(q, k, v):
     o = jnp.tanh(o)
     return jnp.reshape(o, o.shape)
 """,
+    "unguarded-shared-state": """
+import threading
+
+class Frontend:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles = {}
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._pump)
+        self._thread.start()
+
+    def submit(self, rid, handle):
+        with self._lock:
+            self._handles[rid] = handle
+
+    def _pump(self):
+        return self._handles.get("r0")  # engine-role read, no lock
+""",
+    "blocking-in-event-loop": """
+import time
+
+class Server:
+    async def handle(self, handle):
+        time.sleep(0.1)        # parks every connection
+        handle.done.wait()     # blocks the loop on another thread
+        return handle
+""",
+    "lock-order-inversion": """
+import threading
+
+state_lock = threading.Lock()
+io_lock = threading.Lock()
+
+def flush():
+    with state_lock:
+        with io_lock:
+            pass
+
+def snapshot():
+    with io_lock:
+        with state_lock:  # opposite order: deadlock-capable
+            pass
+""",
+    "loop-call-from-wrong-thread": """
+import threading
+
+class Bridge:
+    def __init__(self, loop):
+        self.loop = loop
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._pump)
+        self._thread.start()
+
+    def _pump(self):
+        self.loop.call_soon(print, "tick")  # engine thread, unsafe API
+""",
 }
 
 GOOD = {
@@ -266,6 +326,67 @@ def fused_kernel(q, k, v):
 
 def _private_helper(q, k, v):
     return fused_kernel(q, k, v)  # private: exempt
+""",
+    "unguarded-shared-state": """
+import threading
+
+class Frontend:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles = {}
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._pump)
+        self._thread.start()
+
+    def submit(self, rid, handle):
+        with self._lock:
+            self._handles[rid] = handle
+
+    def _pump(self):
+        with self._lock:
+            return self._handles.get("r0")
+""",
+    "blocking-in-event-loop": """
+import asyncio
+
+class Server:
+    async def handle(self, loop, handle):
+        await asyncio.sleep(0.1)                          # awaited: fine
+        await loop.run_in_executor(None, handle.done.wait)  # off-loop: fine
+        return handle
+""",
+    "lock-order-inversion": """
+import threading
+
+state_lock = threading.Lock()
+io_lock = threading.Lock()
+
+def flush():
+    with state_lock:
+        with io_lock:
+            pass
+
+def snapshot():
+    with state_lock:  # same global order everywhere
+        with io_lock:
+            pass
+""",
+    "loop-call-from-wrong-thread": """
+import threading
+
+class Bridge:
+    def __init__(self, loop):
+        self.loop = loop
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._pump)
+        self._thread.start()
+
+    def _pump(self):
+        self.loop.call_soon_threadsafe(print, "tick")  # the sanctioned crossing
 """,
 }
 

@@ -1,0 +1,419 @@
+"""mdi-race static analysis (`analysis/threads.py`): thread-role
+inference (seeds, propagation, annotation pinning) and the four
+concurrency rules, beyond the bad/good fixture pairs in test_lint.py.
+
+The repo self-check in test_lint.py already gates `mdi-lint
+mdi_llm_tpu/` clean with these rules enabled; this file pins the
+SEMANTICS — which code shapes seed which role, what counts as a write,
+what the lock-guard scoping is — so a refactor of the inference can't
+silently hollow the rules out.
+"""
+
+import ast
+
+import pytest
+
+from mdi_llm_tpu.analysis import lint_source
+from mdi_llm_tpu.analysis.core import Baseline, ModuleInfo
+from mdi_llm_tpu.analysis.cli import main as lint_main
+from mdi_llm_tpu.analysis.threads import thread_model
+
+THREAD_RULES = (
+    "unguarded-shared-state",
+    "blocking-in-event-loop",
+    "lock-order-inversion",
+    "loop-call-from-wrong-thread",
+)
+
+
+def roles(src):
+    """{function_name: sorted role list} for a snippet."""
+    model = thread_model(ModuleInfo("snippet.py", src))
+    return {i.name: sorted(i.roles) for i in model.funcs.values()}
+
+
+def lint(src, rule):
+    return lint_source(src, path="snippet.py", select=[rule])
+
+
+# ---------------------------------------------------------------------------
+# role inference
+# ---------------------------------------------------------------------------
+
+
+def test_seeds_cover_the_three_entry_shapes():
+    src = """
+import threading
+
+def sink(event):
+    pass
+
+class Front:
+    def __init__(self, loop):
+        self.loop = loop
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._pump)
+        self._thread.start()
+        self.loop.call_soon_threadsafe(sink, "hello")
+
+    def _pump(self):
+        pass
+
+    async def respond(self):
+        pass
+"""
+    r = roles(src)
+    assert r["_pump"] == ["engine"], "Thread(target=...) seeds engine"
+    assert r["sink"] == ["loop"], "call_soon_threadsafe target runs on-loop"
+    assert r["respond"] == ["any", "loop"], "async def + public spawner method"
+    assert r["start"] == ["any"], "public method of a thread-spawning class"
+    assert r["__init__"] == [], "construction happens-before publication"
+
+
+def test_roles_propagate_through_calls_callbacks_and_properties():
+    src = """
+import threading
+
+class Front:
+    def __init__(self, engine):
+        self.engine = engine
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._pump)
+        self._thread.start()
+
+    @property
+    def idle(self):
+        return True
+
+    def drain(self):
+        return self.idle           # property read: role reaches idle
+
+    def _on_token(self, tok):
+        pass
+
+    def _collect(self):
+        pass
+
+    def _pump(self):
+        self._collect()                              # direct call
+        self.engine.run(stream_cb=self._on_token)    # callback handoff
+"""
+    r = roles(src)
+    assert "engine" in r["_collect"], "self.m() call propagates"
+    assert "engine" in r["_on_token"], "callback argument propagates"
+    assert "any" in r["idle"], "self.prop read propagates"
+    # the Thread target handoff must NOT leak the caller's any-role into
+    # the engine cone: _pump runs only on the spawned thread
+    assert r["_pump"] == ["engine"]
+
+
+def test_annotation_pins_and_overrides():
+    src = """
+import threading
+
+class Front:
+    def start(self):
+        t = threading.Thread(target=self._pump)
+        t.start()
+
+    def _pump(self):
+        self.report()
+
+    def report(self):  # mdi-thread: any
+        pass
+
+    # mdi-thread: engine
+    def helper(self):
+        pass
+"""
+    r = roles(src)
+    assert r["report"] == ["any"], "pinned: engine must not propagate in"
+    assert r["helper"] == ["engine"], "annotation on the line above the def"
+
+
+def test_unknown_annotation_role_is_itself_a_finding():
+    src = """
+def f():  # mdi-thread: gpu
+    pass
+"""
+    fs = lint(src, "unguarded-shared-state")
+    assert len(fs) == 1 and "unknown thread role" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-state semantics
+# ---------------------------------------------------------------------------
+
+SPAWNER = """
+import threading
+
+class Front:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.limit = 8
+        self.items = []
+
+    def start(self):
+        t = threading.Thread(target=self._pump)
+        t.start()
+
+    def submit(self, x):
+        {submit_body}
+
+    def _pump(self):
+        {pump_body}
+"""
+
+
+def spawner(submit_body="pass", pump_body="pass"):
+    return SPAWNER.format(submit_body=submit_body, pump_body=pump_body)
+
+
+def test_cross_role_unguarded_write_fires_once_per_attribute():
+    src = spawner("self.items.append(x)",
+                  "batch = self.items\n        self.items = []")
+    fs = lint(src, "unguarded-shared-state")
+    assert len(fs) == 1, "one finding per (class, attr), not per access"
+    assert "self.items" in fs[0].message
+    # anchored at the first unguarded access OUTSIDE __init__ (the
+    # construction write is exempt: publication is the happens-before)
+    assert fs[0].line_text.strip() == "self.items.append(x)"
+    assert "_pump" in fs[0].message, "the other racing site is named"
+
+
+def test_guarded_accesses_are_clean_and_with_scoping_is_lexical():
+    guarded = spawner(
+        "with self._lock:\n            self.items.append(x)",
+        "with self._lock:\n            self.items.clear()",
+    )
+    assert lint(guarded, "unguarded-shared-state") == []
+    # the with-block must cover the access lexically; a lock taken and
+    # RELEASED earlier in the function is not a guard
+    released = spawner(
+        "with self._lock:\n            pass\n        self.items.append(x)",
+        "with self._lock:\n            self.items.clear()",
+    )
+    assert len(lint(released, "unguarded-shared-state")) == 1
+
+
+def test_single_role_and_read_only_attrs_are_exempt():
+    # written + read on the engine role only: no cross-role sharing
+    engine_only = spawner("pass", "self.items.append(1)\n        self.items.clear()")
+    assert lint(engine_only, "unguarded-shared-state") == []
+    # read from both roles but written only in __init__: config constant
+    reads = spawner("n = self.limit", "n = self.limit")
+    assert lint(reads, "unguarded-shared-state") == []
+
+
+def test_sync_primitives_are_exempt_by_type():
+    # an Event is MEANT to be shared; flagging it would force absurd locks
+    src = spawner("self._stop.set()", "self._stop.wait()")
+    assert lint(src, "unguarded-shared-state") == []
+
+
+@pytest.mark.parametrize("write", [
+    "self.items = [x]",          # rebind
+    "self.items += [x]",         # aug-assign RMW
+    "self.items.append(x)",      # in-place mutator
+])
+def test_every_write_shape_is_detected(write):
+    src = spawner(write, "n = len(self.items)")
+    assert len(lint(src, "unguarded-shared-state")) == 1, write
+
+
+def test_suppression_with_justification_silences_the_attr():
+    src = spawner(
+        "with self._lock:\n            self.items.append(x)",
+        "# mdi-lint: disable-next-line=unguarded-shared-state -- GIL-atomic len\n"
+        "        n = len(self.items)",
+    )
+    assert lint(src, "unguarded-shared-state") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-in-event-loop semantics
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_shapes_inside_async_def():
+    src = """
+import time
+import subprocess
+
+class S:
+    async def handle(self, lock, q):
+        time.sleep(0.5)
+        lock.acquire()
+        subprocess.run(["ls"])
+"""
+    fs = lint(src, "blocking-in-event-loop")
+    assert len(fs) == 3
+
+
+def test_awaited_and_off_loop_shapes_are_clean():
+    src = """
+import asyncio
+
+class S:
+    async def handle(self, loop, handle, conn):
+        await asyncio.sleep(0.1)
+        await handle.done_event.wait()
+        await loop.run_in_executor(None, handle.done.wait)
+        await loop.run_in_executor(None, lambda: conn.lock.acquire())
+        parts = ", ".join(["a", "b"])   # str.join is not Thread.join
+        return parts
+"""
+    assert lint(src, "blocking-in-event-loop") == []
+
+
+def test_nested_sync_def_inside_async_is_not_the_loop():
+    src = """
+class S:
+    async def stream(self, loop, q):
+        def sink(event):       # runs on the ENGINE thread
+            q.lock.acquire()   # fine there
+            q.lock.release()
+        return sink
+"""
+    assert lint(src, "blocking-in-event-loop") == []
+
+
+def test_thread_join_in_async_def_is_flagged():
+    src = """
+class S:
+    async def shutdown(self):
+        self.engine_thread.join()
+"""
+    fs = lint(src, "blocking-in-event-loop")
+    assert len(fs) == 1 and ".join()" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion semantics
+# ---------------------------------------------------------------------------
+
+
+def test_single_statement_with_items_count_as_an_order():
+    src = """
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+def f():
+    with a_lock, b_lock:
+        pass
+
+def g():
+    with b_lock:
+        with a_lock:
+            pass
+"""
+    fs = lint(src, "lock-order-inversion")
+    assert fs, "with a, b acquires left-to-right"
+    assert all(f.rule == "lock-order-inversion" for f in fs)
+
+
+def test_consistent_order_and_single_lock_are_clean():
+    src = """
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+def f():
+    with a_lock:
+        with b_lock:
+            pass
+
+def g():
+    with a_lock, b_lock:
+        pass
+
+def h():
+    with a_lock:
+        pass
+"""
+    assert lint(src, "lock-order-inversion") == []
+
+
+# ---------------------------------------------------------------------------
+# loop-call-from-wrong-thread semantics
+# ---------------------------------------------------------------------------
+
+
+def test_loop_role_and_roleless_functions_are_clean():
+    src = """
+class S:
+    async def handle(self, loop):
+        loop.create_task(self.work())   # on the loop: fine
+
+    async def work(self):
+        pass
+
+def helper(loop):
+    loop.call_soon(print)   # no inferred role: can't judge, stay silent
+"""
+    assert lint(src, "loop-call-from-wrong-thread") == []
+
+
+def test_annotated_engine_function_is_flagged():
+    src = """
+class Bridge:
+    def push(self, loop, event):  # mdi-thread: engine
+        loop.call_soon(print, event)
+"""
+    fs = lint(src, "loop-call-from-wrong-thread")
+    assert len(fs) == 1 and "call_soon_threadsafe" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# registry / CLI / baseline integration
+# ---------------------------------------------------------------------------
+
+
+def test_thread_rules_are_registered_and_listed(capsys):
+    from mdi_llm_tpu.analysis import RULES
+
+    assert set(THREAD_RULES) <= set(RULES)
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in THREAD_RULES:
+        assert rule in out
+
+
+def test_baseline_round_trip_grandfathers_a_thread_finding(tmp_path):
+    bad = spawner("self.items.append(x)", "self.items.clear()")
+    p = tmp_path / "mod.py"
+    p.write_text(bad)
+    base = tmp_path / "base.json"
+    # first run: finding reported, exit 1; --update-baseline records it
+    assert lint_main([str(p), "--baseline", str(base),
+                      "--select", "unguarded-shared-state"]) == 1
+    assert lint_main([str(p), "--baseline", str(base),
+                      "--select", "unguarded-shared-state",
+                      "--update-baseline"]) == 0
+    keys = Baseline.load(base).counts
+    assert any(k.startswith("unguarded-shared-state::") for k in keys)
+    # grandfathered: clean now, and still reported with --no-baseline
+    assert lint_main([str(p), "--baseline", str(base),
+                      "--select", "unguarded-shared-state"]) == 0
+    assert lint_main([str(p), "--no-baseline",
+                      "--select", "unguarded-shared-state"]) == 1
+
+
+def test_repo_is_clean_under_thread_rules_alone():
+    """The concurrency self-check in isolation (the all-rules gate lives
+    in test_lint.py): zero unsuppressed findings across the package."""
+    from mdi_llm_tpu.analysis import lint_paths
+
+    repo = __import__("pathlib").Path(__file__).resolve().parents[1]
+    findings, errors = lint_paths([repo / "mdi_llm_tpu"], root=repo,
+                                  select=list(THREAD_RULES))
+    assert errors == []
+    assert findings == [], [f"{f.path}:{f.line} {f.rule}" for f in findings]
