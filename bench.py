@@ -219,6 +219,13 @@ def build_parser():
                     "pool splits its head dimension over a tp mesh "
                     "(make_mesh); the row reports tokens/s/chip and "
                     "records devices/tp in detail")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="serve mode: pipeline-parallel stages — the "
+                    "layers split over a recurrent ring (stage_layers) "
+                    "with a per-stage paged-pool shard each; composes "
+                    "with --tp (tp x pp devices).  Decode lanes fill the "
+                    "ring: keep --batch >= --pp or the row reports the "
+                    "bubble fraction it idles (detail.pipeline)")
     ap.add_argument("--no-double-buffer", action="store_true",
                     help="serve mode: disable overlapping chunk N's host "
                     "read with chunk N+1's compute")
@@ -312,6 +319,7 @@ def run_preflight(args, cfg, mode):
         n_stages=args.pipeline or 1,
         pipeline=bool(args.pipeline) if mode == "decode" else False,
         tp=getattr(args, "tp", 1) if mode == "serve" else 1,
+        pp=getattr(args, "pp", 1) if mode == "serve" else 1,
         samples_per_slot=args.samples_per_slot,
         n_samples=args.batch,
         batch=args.batch,
@@ -616,10 +624,16 @@ def _build_serving_gen(args, mode="serve"):
     else:
         params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
     mesh = None
-    if args.tp > 1:
+    pp = getattr(args, "pp", 1)
+    if args.tp > 1 or pp > 1:
         from mdi_llm_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh({"tp": args.tp})
+        axes = {}
+        if args.tp > 1:
+            axes["tp"] = args.tp
+        if pp > 1:
+            axes["pp"] = pp
+        mesh = make_mesh(axes)
     gen = Generator(
         cfg, params, max_seq_length=args.seq_len, cache_dtype=kv_dtype,
         mesh=mesh, scan_unroll=args.scan_unroll,
@@ -744,7 +758,7 @@ def run_serve(args):
             total_tok += max(len(a), 1)
         fp_ref["int8_token_match_rate"] = round(match_tok / total_tok, 4)
 
-    n_chips = max(1, args.tp)
+    n_chips = max(1, args.tp) * max(1, args.pp)
     total = stats.tokens_generated / wall if wall else 0.0
     value = total / n_chips  # tokens/s/CHIP: the cross-topology comparable
     base = baseline_for(args.model)
@@ -794,7 +808,9 @@ def run_serve(args):
         "executables": obs.device.to_dict(),
         "crosscheck": cross,
     }
-    tp_tag = f", tp={args.tp}" if args.tp > 1 else ""
+    tp_tag = (f", tp={args.tp}" if args.tp > 1 else "") + (
+        f", pp={args.pp}" if args.pp > 1 else ""
+    )
     # canonical serving stats (ServingStats.to_dict — same dict mdi-serve
     # prints) + bench extras; the percentile block is the production
     # metric tokens/s alone hides (ROADMAP item 2)
@@ -803,6 +819,7 @@ def run_serve(args):
         "tokens_per_s_total": round(total, 2),
         "devices": n_chips,
         "tp": args.tp,
+        "pp": args.pp,
         "wall_s": round(wall, 2),  # timed region, not stats.wall_s
         "latency": {
             name: {k: (round(v, 6) if isinstance(v, float) else v)
@@ -826,6 +843,10 @@ def run_serve(args):
         },
         "device": device_block,
     })
+    if args.pp > 1:
+        # ring topology + fill model (serving/pipeline.py): stages, the
+        # stage layer split, per-stage occupancy and the bubble fraction
+        detail["pipeline"] = engine.pipeline_fill()
     if fp_ref is not None:
         detail["fp_reference"] = fp_ref
     return {
@@ -1378,6 +1399,20 @@ SUITE_ROWS = [
         "flags": ["--mode", "serve", "--tp", "4", "--batch", "8",
                    "--seq-len", "512", "--new-tokens", "128"],
         "ladder": [["--tp", "2"], ["--tp", "1"]],
+        "timeout": 1200,
+    },
+    {  # the PIPELINED serving row: the same cb trace with the layers
+        # split over a 2-stage recurrent ring (serving/pipeline.py), each
+        # stage holding its own paged-pool shard; decode lanes fill the
+        # ring (batch=8 >= pp=2, zero steady-state bubbles).  Unit stays
+        # tokens/s/chip; detail.pipeline records stages, the stage layer
+        # split, per-stage occupancy and the bubble fraction.  The ladder
+        # drops to the single-chip engine so a ring/mesh failure still
+        # records a serving row
+        "name": "serving-cb-pp2",
+        "flags": ["--mode", "serve", "--pp", "2", "--batch", "8",
+                   "--seq-len", "512", "--new-tokens", "128"],
+        "ladder": [["--pp", "1"]],
         "timeout": 1200,
     },
     {  # the quantized-pool rung: the SAME cb trace with the paged pool

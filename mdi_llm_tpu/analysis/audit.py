@@ -490,6 +490,15 @@ def _check_memory(
                 ))
             except ValueError:
                 kv_dev = 0
+            pp = _serving_pp(plan)
+            if pp > 1 and cfg.n_layer >= pp:
+                # pipelined serving: each device holds ONE stage's shard —
+                # l_max zero-padded layer slots instead of all n_layer.
+                # Pool bytes are layer-proportional and divisible by
+                # n_layer, so the rescale is exact (== the kv_pool
+                # breakdown's pool_bytes_per_device)
+                l_max = max(stage_layers(cfg.n_layer, pp))
+                kv_dev = kv_dev // cfg.n_layer * l_max
         else:
             kv_dev = cfg.estimate_kv_bytes(plan.batch, plan.cache_len, plan.kv_dtype)
         act_batch = plan.batch
@@ -536,6 +545,13 @@ def _check_memory(
             per_block = plan.serving.block_bytes(
                 cfg, plan.kv_dtype, tp=_serving_tp(plan)
             )["total_bytes"]
+            pp = _serving_pp(plan)
+            if pp > 1 and cfg.n_layer >= pp:
+                # per-device block cost is one STAGE's slice (l_max layer
+                # slots) under pipelined serving — exact, see kv_dev above
+                per_block = per_block // cfg.n_layer * max(
+                    stage_layers(cfg.n_layer, pp)
+                )
         except ValueError:
             per_block = 0  # unknown kv_dtype: bad-serving-config reported
         fits["max_pool_blocks"] = max(0, int(avail // per_block)) if per_block else 0
@@ -660,6 +676,28 @@ def _check_schedule(
                 f"{lanes} samples saturate this plan)",
             ))
 
+    # pipelined serving (serving/pipeline.py): the scheduler's decode
+    # lanes are the ring's fill, so the paper invariant reads
+    # max_batch >= pp — below it, every ring sweep idles stages
+    if plan.serving is not None:
+        pp = _serving_pp(plan)
+        if pp > 1:
+            lanes = plan.serving.max_batch
+            bubble = max(0.0, 1.0 - min(max(lanes, 0), pp) / pp)
+            breakdown["serving_ring"] = {
+                "stages": pp,
+                "lanes": lanes,
+                "bubble_fraction": round(bubble, 4),
+            }
+            if lanes < pp:
+                findings.append(_finding(
+                    plan, "pipeline-underfill",
+                    f"max_batch={lanes} < pp={pp}: the serving ring idles "
+                    f"{bubble:.0%} of its stages every sweep (decode lanes "
+                    "are the pipeline's fill — set max_batch >= pp to "
+                    "saturate it)",
+                ))
+
 
 def _check_stages(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
     from mdi_llm_tpu.parallel.partition import stage_layers
@@ -689,12 +727,20 @@ def _serving_tp(plan: PlanSpec) -> int:
     return 1
 
 
+def _serving_pp(plan: PlanSpec) -> int:
+    """The serving plan's pipeline degree: the 'pp' mesh axis
+    (serving/pipeline.py stacks a per-stage pool shard over it)."""
+    return max(1, plan.mesh.size("pp"))
+
+
 def _check_serving_mesh(plan: PlanSpec, findings: List[Finding]) -> None:
     """The serving engine's mesh contract (`serving.engine.
-    validate_serving_mesh` + `paged_kv_spec`), checked statically: the only
-    axis that may exceed 1 is tp, and tp must divide n_query_groups (the
-    pool shards its KV-group axis — an indivisible G would silently
-    replicate the pool, tp-fold the HBM the budget promised)."""
+    validate_serving_mesh` + `paged_kv_spec`), checked statically: only tp
+    (which must divide n_query_groups — the pool shards its KV-group axis;
+    an indivisible G would silently replicate the pool, tp-fold the HBM
+    the budget promised) and pp (which must not exceed n_layer — every
+    ring stage needs >= 1 transformer block) may exceed 1, alone or
+    composed."""
     sv = plan.serving
     if sv is None:
         return
@@ -707,13 +753,26 @@ def _check_serving_mesh(plan: PlanSpec, findings: List[Finding]) -> None:
             "shards its KV-group axis (paged_kv_spec), so serving would "
             "silently replicate the whole pool on every chip",
         ))
+    pp = _serving_pp(plan)
+    if pp > 1:
+        from mdi_llm_tpu.parallel.partition import stage_layers
+
+        try:
+            stage_layers(plan.cfg.n_layer, pp)
+        except ValueError as e:
+            findings.append(_finding(
+                plan, "bad-serving-mesh",
+                f"pp={pp} cannot stage {plan.cfg.name}: {e}",
+            ))
     for name, size in plan.mesh.axes:
-        if name == plan.tp_axis or size <= 1:
+        if name == plan.tp_axis or name == "pp" or size <= 1:
             continue
         what = ("dp>1 serving is unsupported (requests are scheduler-"
                 "routed, not batch-split; run one engine per replica)"
                 if name == (plan.dp_axis or "dp")
-                else "only the tp axis shards the paged pool")
+                else "only tp (the pool's KV-group axis) and pp "
+                "(per-stage pool shards) serve the paged pool, alone or "
+                "composed")
         findings.append(_finding(
             plan, "bad-serving-mesh",
             f"serving mesh axis {name!r} (size {size}): {what} — "
@@ -857,6 +916,38 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             # bad-server-config checker sized it against the headroom
             "admission_queue": sv.admission_queue,
         }
+        pp = _serving_pp(plan)
+        if pp > 1 and plan.cfg.n_layer >= pp:
+            from mdi_llm_tpu.parallel.partition import stage_layers
+
+            # per-stage pool shards (serving/pipeline.py): each stage
+            # stores l_max = max(stage_layers) layer slots (zero-padded so
+            # the ring stays single-trace) of every block.  block_bytes is
+            # layer-proportional and divisible by n_layer, so the integer
+            # rescale below is EXACT — the estimate matches the live
+            # stacked (pp, l_max, ...) pool shard byte for byte
+            counts = stage_layers(plan.cfg.n_layer, pp)
+            l_max = max(counts)
+            L = plan.cfg.n_layer
+
+            def per_stage(b):
+                return n_blocks * (
+                    b["kv_bytes"] // L * l_max
+                    + b["scale_bytes"] // L * l_max
+                )
+
+            bb_tp = sv.block_bytes(plan.cfg, plan.kv_dtype, tp=tp)
+            stage_dev = per_stage(bb_tp)  # one stage, one tp shard
+            breakdown["kv_pool"].update({
+                "pp": pp,
+                "stage_layers": counts,
+                "l_max": l_max,
+                # one stage's full shard (tp=1 bytes) and the per-device
+                # slice of it; the stacked pool totals pp x the former
+                "pool_bytes_per_stage": per_stage(bb),
+                "pool_bytes_per_device": stage_dev,
+                "pool_bytes": pp * per_stage(bb),
+            })
 
 
 # ---------------------------------------------------------------------------
@@ -885,6 +976,7 @@ def preflight(
     n_stages: int = 0,
     pipeline: Optional[bool] = None,
     tp: int = 1,
+    pp: int = 1,
     samples_per_slot: int = 1,
     n_samples: Optional[int] = None,
     batch: int = 1,
@@ -907,6 +999,11 @@ def preflight(
         axes["pipe"] = S
     if tp > 1:
         axes["tp"] = int(tp)
+    if pp > 1:
+        # serving-side pipeline axis (serving/pipeline.py): the paged pool
+        # stacks per-stage shards over it — distinct from the dense
+        # pipeline's n_stages/"pipe" plan axis
+        axes["pp"] = int(pp)
     plan = PlanSpec(
         cfg=cfg,
         mesh=MeshSpec.from_dict(axes),

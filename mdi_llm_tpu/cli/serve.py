@@ -98,6 +98,14 @@ def build_parser():
                     "(make_mesh); n_query_groups must divide by it — "
                     "mdi-audit preflights the mesh (bad-serving-mesh). "
                     "1 = single device")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline-parallel serving: split the layers over "
+                    "this many recurrent ring stages (stage_layers "
+                    "starter/secondary policy), each holding its own shard "
+                    "of the paged KV pool; composes with --tp (tp x pp "
+                    "devices).  Decode lanes fill the ring, so keep "
+                    "--max-batch >= --pp (mdi-audit warns with the bubble "
+                    "fraction otherwise).  1 = no pipelining")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable hash-based prefix block reuse")
     ap.add_argument("--temperature", type=float, default=0.0,
@@ -220,6 +228,7 @@ def preflight_serving(args, serving_cfg, origin):
     report = preflight(
         resolve_config(args),
         tp=args.tp,
+        pp=getattr(args, "pp", 1),
         batch=args.max_batch,
         seq_len=args.sequence_length,
         dtype=args.dtype,
@@ -232,9 +241,12 @@ def preflight_serving(args, serving_cfg, origin):
     enforce_preflight(report, origin, allow=args.no_preflight)
     pool = report.breakdown.get("kv_pool", {})
     if pool:
+        axes = " x ".join(
+            f"{ax}={pool[ax]}" for ax in ("tp", "pp") if pool.get(ax, 1) > 1
+        )
         per_dev = (
             f" ({pool['pool_bytes_per_device'] / 2**20:.1f} MiB/device over "
-            f"tp={pool['tp']})" if pool.get("tp", 1) > 1 else ""
+            f"{axes})" if axes else ""
         )
         q_tag = (
             f" [int8 + {pool['scale_bytes'] / 2**20:.2f} MiB scales]"
@@ -257,10 +269,16 @@ def build_generator(args, cfg, params):
     dtype = DTYPES[args.dtype]
     pool_int8 = args.kv_dtype == "int8"
     mesh = None
-    if args.tp > 1:
+    tp, pp = args.tp, getattr(args, "pp", 1)
+    if tp > 1 or pp > 1:
         from mdi_llm_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh({"tp": args.tp})
+        axes = {}
+        if tp > 1:
+            axes["tp"] = tp
+        if pp > 1:
+            axes["pp"] = pp
+        mesh = make_mesh(axes)
     return Generator(
         cfg, params,
         max_seq_length=args.sequence_length,
@@ -360,18 +378,24 @@ def main(argv=None):
 
     # canonical stats (ServingStats.to_dict — the same dict bench serve
     # rows embed) + CLI topology extras + the latency percentile block
+    n_chips = max(1, args.tp) * max(1, args.pp)
     line = stats.to_dict()
     line.update({
         "kv_dtype": engine.kv_dtype_name,
         "tp": args.tp,
-        "devices": args.tp,
-        "tokens_per_s_per_chip": round(stats.tokens_per_s / max(1, args.tp), 2),
+        "pp": args.pp,
+        "devices": n_chips,
+        "tokens_per_s_per_chip": round(stats.tokens_per_s / n_chips, 2),
         "latency": {
             name: {k: (round(v, 6) if isinstance(v, float) else v)
                    for k, v in summ.items()}
             for name, summ in obs.latency_summaries().items()
         },
     })
+    if args.pp > 1:
+        # ring topology + fill model (serving/pipeline.py): stages, lane
+        # fill and the steady-state bubble fraction (docs/perf.md)
+        line["pipeline"] = engine.pipeline_fill()
     if not args.no_device_obs:
         # achieved MFU/MBU against the running chip's peak (null off the
         # peak table, e.g. CPU) — docs/observability.md "Device-side";
@@ -394,7 +418,7 @@ def main(argv=None):
             cfg, serving_cfg, tokens_per_s=stats.tokens_per_s,
             context=ctx_mean, batch=eff_batch,
             weight_bytes=rf.param_bytes(gen.params),
-            device_kind=kind, n_chips=max(1, args.tp), dtype=args.dtype,
+            device_kind=kind, n_chips=n_chips, dtype=args.dtype,
         )
         line["device"] = {
             "kind": kind,

@@ -1001,12 +1001,16 @@ class Generator:
         (decode lanes + prefill chunks in ONE ragged forward per
         dispatch), mid-batch retirement, prefix-cached blocks.
 
-        Works on a single device or a tensor-parallel mesh: under
+        Works on a single device or a parallel mesh: under
         `mesh={"tp": N}` the paged pool shards its KV-group axis across
         the chips (each holds its head-slice of every block) and every
         serving dispatch runs the same per-shard math as the dense tp
-        forward — one all-reduce per layer.  Unsupported meshes (dp > 1,
-        ep/sp axes) are rejected HERE, before any pool is allocated.
+        forward — one all-reduce per layer.  Under `mesh={"pp": N}`
+        (alone or composed with tp) the layers split over a recurrent
+        pipeline ring and each stage owns its own shard of the paged
+        pool (`serving.pipeline.PipelinedServingEngine`).  Unsupported
+        meshes (dp > 1, ep/sp axes) are rejected HERE, before any pool
+        is allocated.
 
         Pass a `ServingConfig`, or its fields as keywords::
 
@@ -1039,6 +1043,15 @@ class Generator:
             serving = ServingConfig(**knobs)
         elif knobs:
             raise ValueError("pass a ServingConfig or keywords, not both")
+        if self.mesh is not None and int(
+            dict(self.mesh.shape).get("pp", 1)
+        ) > 1:
+            # pp axis present: stage the layers over the recurrent ring
+            # (serving/pipeline.py), each stage owning its own shard of
+            # the paged pool — the request/stats surface is identical
+            from mdi_llm_tpu.serving.pipeline import PipelinedServingEngine
+
+            return PipelinedServingEngine(self, serving, obs=obs, policy=policy)
         return ServingEngine(self, serving, obs=obs, policy=policy)
 
 

@@ -110,36 +110,50 @@ __all__ = ["ServingEngine", "ServingStats", "validate_serving_mesh"]
 
 def validate_serving_mesh(mesh) -> None:
     """Reject meshes the serving engine cannot run, naming the offending
-    axis.  Called from `Generator.serve()` (so the error fires BEFORE any
-    pool allocation) and defensively from `ServingEngine.__init__` for
-    direct constructions.
+    axis AND the supported alternative.  Called from `Generator.serve()`
+    (so the error fires BEFORE any pool allocation) and defensively from
+    `ServingEngine.__init__` for direct constructions.
 
-    Supported: no mesh, or a mesh whose only >1 axis is `tp` (the paged
-    pool shards its KV-group axis).  dp>1 is unsupported for serving —
-    requests are scheduler-routed, not batch-split, so a dp axis would
-    replicate the pool without serving anything on the replicas.  ep would
-    need the MoE all_to_all threaded through every serving dispatch, and
-    sp's sequence-sharded cache contradicts the pooled block layout."""
+    Supported: no mesh, a `tp` axis (the paged pool shards its KV-group
+    axis), a `pp` axis (layer stages each own their shard of the pool —
+    `serving/pipeline.py`'s recurrent ring), or `tp` and `pp` composed
+    (tp stays a GSPMD auto axis inside each stage).  dp>1 is unsupported
+    for serving — requests are scheduler-routed, not batch-split, so a dp
+    axis would replicate the pool without serving anything on the
+    replicas.  ep would need the MoE all_to_all threaded through every
+    serving dispatch, and sp's sequence-sharded cache contradicts the
+    pooled block layout."""
     if mesh is None:
         return
     for axis in mesh.axis_names:
         size = int(mesh.shape[axis])
-        if axis == "tp":
+        if size <= 1 or axis in ("tp", "pp"):
             continue
         if axis == "dp":
-            if size > 1:
-                raise ValueError(
-                    f"serving does not support dp={size}: the engine "
-                    "schedules requests into slots, not dp-split batches "
-                    "— use a tp-only mesh (or run one engine per replica)"
-                )
-            continue
-        if size > 1:
             raise ValueError(
-                f"serving does not support a mesh with axis {axis!r} "
-                f"(size {size}): only tensor parallelism ('tp') shards "
-                "the paged pool — build the Generator with a tp-only mesh"
+                f"serving does not support dp={size}: the engine "
+                "schedules requests into slots, not dp-split batches — "
+                "use a tp and/or pp mesh (or run one engine per replica)"
             )
+        if axis == "ep":
+            raise ValueError(
+                f"serving does not support ep={size}: expert parallelism "
+                "would need the MoE all_to_all threaded through every "
+                "serving dispatch — shard experts within a stage via tp, "
+                "or split layers over pp"
+            )
+        if axis == "sp":
+            raise ValueError(
+                f"serving does not support sp={size}: a sequence-sharded "
+                "cache contradicts the pooled block layout (every block "
+                "holds full heads of a token span) — use tp and/or pp"
+            )
+        raise ValueError(
+            f"serving does not support a mesh with axis {axis!r} "
+            f"(size {size}): only tensor parallelism ('tp', KV-group "
+            "sharding) and pipeline parallelism ('pp', per-stage pool "
+            "shards), alone or composed, serve the paged pool"
+        )
 
 
 def _pin_kv(kv, sharding):
@@ -148,15 +162,17 @@ def _pin_kv(kv, sharding):
     GSPMD may pick a different output layout per executable — and the NEXT
     dispatch would retrace on the new input sharding, tripping the
     CompileGuard zero-post-warmup-recompile contract.  `sharding` is a
-    (pool, scale) pair: the int8 pool's 3-D scale leaves pin the matching
+    (pool, scale) pair: the int8 pool's scale leaves pin the matching
     group-sharded layout (`paged_kv_scale_spec`); fp pools only ever see
-    the 5-D branch."""
+    the payload branch.  The ndim split covers both pool layouts: the
+    flat 5-D payload / 3-D scale, and the pipeline engine's stage-stacked
+    6-D payload / 4-D scale."""
     if sharding is None:
         return kv
     pool_s, scale_s = sharding
     return jax.tree_util.tree_map(
         lambda x: jax.lax.with_sharding_constraint(
-            x, pool_s if x.ndim == 5 else scale_s
+            x, pool_s if x.ndim >= 5 else scale_s
         ),
         kv,
     )
@@ -316,6 +332,10 @@ class ServingEngine:
         validate_serving_mesh(gen.mesh)  # serve() checks too; direct
         # constructions must hit the same wall before the pool allocates
         self.gen = gen
+        # the parameter bundle every dispatch passes: gen.params here; the
+        # pipeline engine swaps in its stage-stacked bundle after super()
+        # so the inherited _run_* host loops dispatch it unchanged
+        self._params = gen.params
         self.cfg = serving
         # observability (obs.ServingObserver or None): fed exclusively at
         # the host-sync boundaries this loop already owns — enabling it
@@ -407,9 +427,7 @@ class ServingEngine:
             self.max_seq_length, policy=policy,
         )
         self.scheduler.observer = obs  # lifecycle edges report from there
-        self._kv = gen._place_paged_kv(transformer.init_paged_kv_cache(
-            gen.cfg, num_blocks, bs, dtype=self._pool_dtype
-        ))
+        self._kv = self._init_pool(num_blocks, bs)
         # persistent host-side block table, updated incrementally as blocks
         # are appended / slots reassigned — rebuilding the full
         # (max_batch, max_blocks_per_seq) ndarray per decode dispatch was
@@ -426,7 +444,7 @@ class ServingEngine:
         # pool geometry/batch/chunk widths key the entries via call shapes)
         # leaves the traces unchanged, so only use_kernel partitions it
         self._fns: Dict[Any, Any] = gen._serve_fns.setdefault(
-            ("serve", serving.use_kernel), {}
+            self._fn_cache_key(), {}
         )
         # sampling knobs are engine-lifetime constants: upload the traced
         # operands once, not two tiny transfers per decode step
@@ -439,6 +457,24 @@ class ServingEngine:
         self.stats = ServingStats()
         self._results: Dict[str, List[int]] = {}
         self._stream_cb = None
+
+    # -- backend seams (overridden by serving/pipeline.py) -------------------
+
+    def _fn_cache_key(self):
+        """Namespace key of this engine's compiled-phase cache on
+        `gen._serve_fns`.  Execution backends with different traces for
+        the same (B, T) shapes (the pipeline engine's staged rings) must
+        re-key so two engines of one Generator never share executables."""
+        return ("serve", self.cfg.use_kernel)
+
+    def _init_pool(self, num_blocks: int, bs: int):
+        """Allocate and place the device-side paged pool.  The base
+        engine's flat (L, num_blocks, bs, G, hs) pool, tp-sharded along
+        its KV-group axis; the pipeline engine overrides this with the
+        per-stage stacked layout."""
+        return self.gen._place_paged_kv(transformer.init_paged_kv_cache(
+            self.gen.cfg, num_blocks, bs, dtype=self._pool_dtype
+        ))
 
     # -- compiled phases -----------------------------------------------------
 
@@ -752,7 +788,7 @@ class ServingEngine:
         fn = self._mixed_fn(B, T)
         self._introspect(
             "mixed", (B, T), fn,
-            (self.gen.params, tokens, self._kv, tables, pos, q_slot,
+            (self._params, tokens, self._kv, tables, pos, q_slot,
              q_start, q_len, last_idx, self.gen.key, self._t_op, self._p_op),
             {"mode": self._sample_mode, "top_k": self.cfg.top_k},
         )
@@ -760,7 +796,7 @@ class ServingEngine:
         self._kv = None  # donated
         try:
             nxt, self._kv, self.gen.key = fn(
-                self.gen.params, jnp.asarray(tokens), kv,
+                self._params, jnp.asarray(tokens), kv,
                 jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(q_slot),
                 jnp.asarray(q_start), jnp.asarray(q_len),
                 jnp.asarray(last_idx), self.gen.key, self._t_op, self._p_op,
@@ -884,7 +920,7 @@ class ServingEngine:
         fn = self._decode_fn(B)
         self._introspect(
             "decode", (B,), fn,
-            (self.gen.params, tok, self._kv, tables, pos, self.gen.key,
+            (self._params, tok, self._kv, tables, pos, self.gen.key,
              self._t_op, self._p_op),
             {"mode": self._sample_mode, "top_k": self.cfg.top_k},
         )
@@ -892,7 +928,7 @@ class ServingEngine:
         self._kv = None  # donated
         try:
             nxt, self._kv, self.gen.key = fn(
-                self.gen.params, jnp.asarray(tok), kv, jnp.asarray(tables),
+                self._params, jnp.asarray(tok), kv, jnp.asarray(tables),
                 jnp.asarray(pos), self.gen.key, self._t_op, self._p_op,
                 mode=self._sample_mode, top_k=self.cfg.top_k,
             )
@@ -1021,7 +1057,7 @@ class ServingEngine:
         tables = self._sync_tables(live)
         self._introspect(
             "decode_chunk", (B, K), fn,
-            (self.gen.params, tok, self._kv, tables, pos, limits, stop1,
+            (self._params, tok, self._kv, tables, pos, limits, stop1,
              self.gen.key, self._t_op, self._p_op),
             {"mode": self._sample_mode, "top_k": self.cfg.top_k},
         )
@@ -1031,7 +1067,7 @@ class ServingEngine:
             self._kv = None  # donated
             try:
                 toks_j, tok_d, pos_d, self._kv, self.gen.key = fn(
-                    self.gen.params, tok_d, kv, jnp.asarray(tables), pos_d,
+                    self._params, tok_d, kv, jnp.asarray(tables), pos_d,
                     jnp.asarray(limits), stop_d, self.gen.key,
                     self._t_op, self._p_op,
                     mode=self._sample_mode, top_k=self.cfg.top_k,
@@ -1125,13 +1161,13 @@ class ServingEngine:
         fn = self._verify_fn(B, K + 1)
         self._introspect(
             "verify", (B, K + 1), fn,
-            (self.gen.params, toks_in, self._kv, tables, pos),
+            (self._params, toks_in, self._kv, tables, pos),
         )
         kv = self._kv
         self._kv = None  # donated
         try:
             g, self._kv = fn(
-                self.gen.params, jnp.asarray(toks_in), kv,
+                self._params, jnp.asarray(toks_in), kv,
                 jnp.asarray(tables), jnp.asarray(pos),
             )
         except Exception:

@@ -1,0 +1,867 @@
+"""Pipeline-parallel serving: the paper's recurrent ring fused with
+continuous batching.
+
+`PipelinedServingEngine` maps the recurrent pipeline execution model
+(`parallel/pipeline.py`'s ring of layer stages connected by
+`jax.lax.ppermute`) onto the continuous-batching serving stack
+(`serving/engine.py`): the model's layers split over `pp` stages
+(`parallel/partition.stage_layers` — the reference's starter/secondary
+policy), every stage owns ITS OWN shard of the paged KV pool (stage s
+holds the K/V blocks of stage s's layers and nothing else), and the
+scheduler's decode lanes become the pipeline's fill — the paper's
+"n_samples >= n_stages keeps every stage busy" invariant, re-read as
+`max_batch >= pp` (mdi-audit's `pipeline-underfill` warns with the
+bubble fraction when a plan violates it).
+
+Execution model — one donated ring per host sync
+------------------------------------------------
+Every inherited host-side dispatch (`_run_mixed`, `_run_decode`,
+`_run_decode_chunk`, `_run_spec_decode`) maps onto ONE jitted call whose
+body is a `jax.lax.scan` of ring ticks inside a `jax.shard_map` manual
+over the `pp` axis only (a composed `tp` axis stays automatic, so GSPMD
+lays each stage's matmuls out under the Megatron shardings — the same
+partial-manual idiom as `PipelineEngine`).  Per tick, each stage runs
+its (zero-padded to `l_max`, hence single-trace) block stack over one
+microbatch and `ppermute`s the activation to the next stage:
+
+- **mixed** `(1, token_budget)`: the packed ragged batch splits into
+  `pp` equal token segments; segment m enters stage 0 at tick m, the
+  last stage accumulates finished hidden states, and after `2*pp - 1`
+  ticks the accumulator is `psum` to every device.  The head + ONE
+  `jax.random.split` + sample run OUTSIDE the shard_map at the exact
+  single-device shapes, so the sampled-token math and the RNG cadence
+  are the base engine's, bit for bit.
+- **decode** `(B,)` / **verify** `(B, K+1)`: lanes split into `pp`
+  groups of `ceil(B/pp)`; same 2*pp-1-tick sweep, head/sample (or
+  argmax) outside.
+- **decode_chunk** `(B, K)`: the TRUE recurrent ring.  Each lane group
+  is a payload {x, tok, pos, done, step} circling the ring; when a
+  payload returns to stage 0 it is sampled (head at `(Bg, 1, D)`),
+  advanced one decode step, re-embedded and immediately relaunched —
+  `K*pp + pp` ticks serve K tokens for every lane with zero stage
+  idling once the ring fills.  The K per-step subkeys are pre-split
+  OUTSIDE the ring in the base engine's exact order, so the returned
+  key state matches the single-device engine; per-group sampling
+  consumes subkey k for group step k (greedy streams — the serving
+  parity contract — are key-independent and exactly preserved).
+
+Contract inheritance
+--------------------
+All host-side machinery is inherited unchanged — scheduler, block
+tables, prefix cache, preemption, double-buffering, stats, obs hooks —
+so the host-sync cadence is bit-identical to the single-device engine
+by construction, and the dispatch shapes stay bounded and
+prompt-independent (zero post-warmup recompiles; pinned by
+tests/test_pp_serving.py's CompileGuard twin).  Invalid ring ticks
+(fill/drain bubbles, batch padding) write through ZEROED block tables,
+which the paged-attention op redirects to the pool's reserved trash
+block — the same mechanism dead decode lanes already ride.
+
+The Pallas paged kernels are not wired through the ring
+(`use_kernel=True` is refused actionably); the exact lax fallback —
+what the parity contract is stated against — serves every stage.
+
+jax compatibility: pp-only meshes run on both shard_map generations
+(the ring is then fully manual).  Composing tp requires the modern
+`jax.shard_map(..., axis_names=)` — the older experimental partial-auto
+shard_map crashes XLA's SPMD partitioner on the ring's in-scan KV-pool
+scatters, so tp x pp on such builds is refused at engine construction
+with the upgrade path spelled out.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mdi_llm_tpu.config import ServingConfig
+from mdi_llm_tpu.models import transformer
+from mdi_llm_tpu.ops.sampling import sample_traced
+from mdi_llm_tpu.parallel.partition import (
+    pad_stage_blocks,
+    split_params,
+    stage_layers,
+)
+from mdi_llm_tpu.serving.engine import (
+    ServingEngine,
+    _pin_kv,
+    validate_serving_mesh,
+)
+
+__all__ = ["PipelinedServingEngine"]
+
+
+def _shard_map_api() -> Optional[str]:
+    """Which shard_map generation this jax build ships: "new"
+    (`jax.shard_map(..., axis_names=, check_vma=)`), "experimental"
+    (`jax.experimental.shard_map.shard_map(..., auto=, check_rep=)`),
+    or None (no manual-region support at all)."""
+    if hasattr(jax, "shard_map"):
+        return "new"
+    try:
+        from jax.experimental.shard_map import shard_map  # noqa: F401
+
+        return "experimental"
+    except ImportError:
+        return None
+
+
+def _ring_shard_map(f, mesh, in_specs, out_specs, check):
+    """Build the ring's shard_map, manual over the "pp" axis only (any
+    composed tp axis stays automatic so GSPMD lays out each stage's
+    matmuls), across both jax shard_map generations."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            axis_names={"pp"}, check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    auto = frozenset(mesh.axis_names) - {"pp"}
+    # partial-auto shard_map predates check_rep support for auto axes
+    return shard_map(
+        f, mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=bool(check) and not auto, auto=auto,
+    )
+
+
+def _stage_run(cfg, blocks, rope, kv_loc, x, pos, tables, moe_impl, unroll,
+               ragged=None):
+    """One stage's block stack over one microbatch: rope gathers with the
+    documented mode="clip" (serving positions include the past-coverage
+    trash position), then `transformer.run_blocks` through the stage's
+    slice of the paged pool.  `tables` already zeroed for invalid ticks —
+    the zero row is the trash-block redirect."""
+    cos = jnp.take(rope[0], pos, axis=0, mode="clip")
+    sin = jnp.take(rope[1], pos, axis=0, mode="clip")
+    return transformer.run_blocks(
+        cfg, blocks, x, pos, cos, sin, kv=kv_loc,
+        moe_impl=moe_impl, unroll=unroll,
+        paged_tables=tables, paged_kernel=False, paged_ragged=ragged,
+    )
+
+
+class PipelinedServingEngine(ServingEngine):
+    """Continuous-batching engine over a `pp` (optionally x `tp`) mesh.
+
+    Build via `Generator.serve(...)` on a Generator whose mesh has a
+    `pp` axis of size >= 2 (`make_mesh({"pp": N})` or
+    `make_mesh({"pp": N, "tp": M})`); `serve()` routes here
+    automatically.  The request surface, scheduler, results and stats
+    are the base engine's — only the device execution backend changes.
+    """
+
+    def __init__(self, gen, serving: ServingConfig, obs=None, policy=None):
+        mesh = gen.mesh
+        if mesh is None or int(dict(mesh.shape).get("pp", 1)) <= 1:
+            raise ValueError(
+                "PipelinedServingEngine needs a mesh with a 'pp' axis of "
+                "size >= 2 (make_mesh({'pp': N[, 'tp': M]})); for "
+                "single-device or tp-only serving use ServingEngine"
+            )
+        validate_serving_mesh(mesh)
+        api = _shard_map_api()
+        if api is None:
+            raise ValueError(
+                "pipeline-parallel serving needs shard_map (the stage "
+                "ring is a manual-pp region); this jax build has neither "
+                "jax.shard_map nor jax.experimental.shard_map — drop the "
+                "pp axis for tp/single-device serving"
+            )
+        if api != "new" and int(dict(mesh.shape).get("tp", 1)) > 1:
+            raise ValueError(
+                "composed tp x pp serving needs the modern jax.shard_map "
+                "(partial-auto rings on this older jax crash XLA's SPMD "
+                "partitioner: KV-pool scatters inside the tick scan of a "
+                "manual-pp-with-auto-tp region are unpartitionable) — "
+                "upgrade jax, or serve with pp only / tp only on this "
+                "build"
+            )
+        if serving.use_kernel:
+            raise ValueError(
+                "pipeline-parallel serving (pp > 1) runs the exact lax "
+                "paged-attention fallback inside the stage ring; "
+                "use_kernel=True is unsupported — leave use_kernel "
+                "unset/False, or drop the pp axis to use the Pallas "
+                "kernels under tp-only serving"
+            )
+        S = int(mesh.shape["pp"])
+        tp = int(dict(mesh.shape).get("tp", 1))
+        # raises actionably when n_layer < pp (every stage needs a block)
+        self._stage_counts = stage_layers(gen.cfg.n_layer, S)
+        self._pp = S
+        self._tp_size = tp
+        self._l_max = max(self._stage_counts)
+        tp_ax = "tp" if tp > 1 else None
+        # stage-stacked pool layout: payload (S, l_max, NB, BS, G, hs),
+        # int8 scales (S, l_max, NB, G) — stage axis manual over pp, the
+        # KV-group axis sharded over tp exactly like the flat pool
+        # (parallel.sharding.paged_kv_spec)
+        self._pool_spec = P("pp", None, None, None, tp_ax, None)
+        self._scale_spec = P("pp", None, None, tp_ax)
+        super().__init__(gen, serving, obs=obs, policy=policy)
+        # pin the stacked layout (overrides the flat 5-D/3-D pair the
+        # base __init__ took from the Generator)
+        self._kv_sharding_pair = (
+            NamedSharding(mesh, self._pool_spec),
+            NamedSharding(mesh, self._scale_spec),
+        )
+        # per-stage weights: starter/secondary split, zero-padded to
+        # l_max layers (zero blocks are exact identities) and stacked on
+        # a leading stage axis laid out over pp; with tp the weight dims
+        # additionally follow the Megatron specs so GSPMD (tp is an auto
+        # axis of the ring shard_map) places the per-stage all-reduces
+        stages = split_params(gen.cfg, gen.params, S)
+        blocks_np = pad_stage_blocks(stages, self._l_max)
+        repl_sh = NamedSharding(mesh, P())
+        if tp > 1:
+            from mdi_llm_tpu.parallel.sharding import (
+                adapt_specs_to_tree,
+                param_specs,
+            )
+
+            bspecs = adapt_specs_to_tree(
+                param_specs(gen.cfg, "tp")["blocks"], blocks_np,
+                leading_axes=1, axis_sizes={"tp": tp},
+            )
+            stage_blocks = jax.tree_util.tree_map(
+                lambda a, sp: jax.device_put(
+                    a, NamedSharding(mesh, P("pp", *sp))
+                ),
+                blocks_np, bspecs,
+            )
+        else:
+            pipe_sh = NamedSharding(mesh, P("pp"))
+            stage_blocks = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, pipe_sh), blocks_np
+            )
+        # embedding / final norm / head replicated on every stage (only
+        # stage 0 reads them meaningfully; the ring samples at
+        # single-device shapes outside the shard_map)
+        head_params = {
+            k: stages[0][k]
+            for k in ("wte", "wpe", "ln_f", "lm_head") if k in stages[0]
+        }
+        head_params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), repl_sh), head_params
+        )
+        rope = tuple(
+            jax.device_put(np.asarray(r), repl_sh) for r in gen.rope
+        )
+        # the bundle every inherited dispatch passes (engine._params seam)
+        self._params = {
+            "blocks": stage_blocks, "head": head_params, "rope": rope,
+        }
+        self._x_dtype = transformer.param_dtype(gen.params)
+        self._check_vma = jax.process_count() == 1 and tp == 1
+
+    # -- backend seams --------------------------------------------------------
+
+    def _fn_cache_key(self):
+        # staged rings trace differently from the flat engine at the same
+        # (B, T) keys — namespace them apart on the shared Generator cache
+        return ("serve-pp", self._pp, self._tp_size)
+
+    def _init_pool(self, num_blocks: int, bs: int):
+        """Per-stage pool shards stacked on a leading stage axis: stage s
+        holds `l_max` layer slots (its own layer count, zero-padded so the
+        ring stays single-trace) of `num_blocks` blocks.  The host-side
+        `KVPool` allocator is unchanged and device-blind — a block id
+        indexes every stage's shard at once, each stage just stores its
+        own layers' K/V under that id."""
+        # the eval_shape is a jax trace: cache the template alongside the
+        # compiled phases (self._fns is not assigned yet at this point in
+        # base __init__) so a second engine on the same Generator stays
+        # trace-free after warmup
+        fns = self.gen._serve_fns.setdefault(self._fn_cache_key(), {})
+        tkey = ("pool_tmpl", num_blocks, bs,
+                jnp.dtype(self._pool_dtype).name, self._l_max)
+        if tkey not in fns:
+            fns[tkey] = jax.eval_shape(
+                lambda: transformer.init_paged_kv_cache(
+                    self.gen.cfg, num_blocks, bs, dtype=self._pool_dtype,
+                    n_layer=self._l_max,
+                )
+            )
+        tmpl = fns[tkey]
+        mesh = self.gen.mesh
+
+        def alloc(leaf):
+            arr = np.zeros((self._pp,) + tuple(leaf.shape), leaf.dtype)
+            spec = self._pool_spec if arr.ndim >= 5 else self._scale_spec
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(alloc, tmpl)
+
+    # -- pipeline topology (bench / cli reporting) ----------------------------
+
+    @property
+    def n_stages(self) -> int:
+        return self._pp
+
+    def pipeline_fill(self) -> Dict[str, Any]:
+        """The fill model the bench row and mdi-audit's
+        `pipeline-underfill` check both report: lanes (= max_batch, the
+        scheduler's pipeline fill), stages, and the steady-state bubble
+        fraction 1 - min(lanes, stages)/stages when the lanes cannot
+        cover the ring."""
+        lanes = self.scheduler.max_batch
+        fill = min(lanes, self._pp) / self._pp
+        return {
+            "stages": self._pp,
+            "lanes": lanes,
+            "stage_layers": list(self._stage_counts),
+            "bubble_fraction": round(max(0.0, 1.0 - fill), 4),
+            # steady-state busy fraction per stage: the ring sweeps are
+            # symmetric, so underfill idles every stage equally
+            "stage_occupancy": [round(fill, 4)] * self._pp,
+        }
+
+    # -- shared ring plumbing -------------------------------------------------
+
+    def _ring_consts(self):
+        """Engine-lifetime constants the ring closures capture — NO self
+        (the fn cache lives on the Generator and must not pin this
+        engine's pool)."""
+        gen = self.gen
+        return dict(
+            gen=gen, cfg=gen.cfg, mesh=gen.mesh, S=self._pp,
+            moe_impl=gen._moe_impl, unroll=gen.scan_unroll,
+            kv_sharding=self._kv_sharding_pair, x_dtype=self._x_dtype,
+            check_vma=self._check_vma,
+            trash_pos=self.max_blocks_per_seq * self.pool.block_size,
+        )
+
+    # -- compiled phases (pp overrides; signatures match the base engine) -----
+
+    def _mixed_fn(self, B: int, T: int):
+        """Unified ragged mixed step over the stage ring: the packed
+        (1, T) batch pads to pp equal token segments inside the jit
+        (padding tokens carry the trash position, exactly like the batch
+        tail the base engine already pads), segment m enters stage 0 at
+        tick m, and the last stage's finished hidden states psum back
+        replicated.  Head + split + sample run outside the shard_map at
+        the base engine's exact shapes."""
+        key_ = ("mixed", B, T)
+        if key_ not in self._fns:
+            c = self._ring_consts()
+            cfg, mesh, S = c["cfg"], c["mesh"], c["S"]
+            seg = -(-T // S)
+            T_pad = seg * S
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            @partial(
+                jax.jit, donate_argnums=(2,),
+                static_argnames=("mode", "top_k"),
+            )
+            def mixed(params, tokens, kv, tables, pos, q_slot, q_start,
+                      q_len, last_idx, key, temperature, top_p, mode, top_k):
+                tok_sg = jnp.pad(
+                    tokens, ((0, 0), (0, T_pad - T))
+                ).reshape(S, seg)
+                pos_sg = jnp.pad(
+                    pos, ((0, 0), (0, T_pad - T)),
+                    constant_values=c["trash_pos"],
+                ).reshape(S, seg)
+                qs_sg = jnp.pad(q_slot, (0, T_pad - T)).reshape(S, seg)
+
+                def ring(sid, blocks, head, rope, kv, tok_sg, pos_sg, qs_sg,
+                         tables, q_start, q_len):
+                    s = sid[0]  # stage id arrives as data: axis_index lowers to
+                    # PartitionId, which GSPMD rejects when tp is auto
+                    blocks = jax.tree_util.tree_map(lambda a: a[0], blocks)
+                    kv_loc = jax.tree_util.tree_map(lambda a: a[0], kv)
+                    D = cfg.n_embd
+                    x0 = jnp.zeros((1, seg, D), c["x_dtype"])
+                    acc0 = jnp.zeros((1, T_pad, D), c["x_dtype"])
+
+                    def body(carry, t):
+                        x, acc, kv_loc = carry
+                        m = t - s
+                        valid = jnp.logical_and(m >= 0, m < S)
+                        mc = jnp.clip(m, 0, S - 1)
+                        tok_m = jax.lax.dynamic_slice_in_dim(
+                            tok_sg, mc, 1, 0)[0]
+                        pos_m = jax.lax.dynamic_slice_in_dim(
+                            pos_sg, mc, 1, 0)[0]
+                        qs_m = jax.lax.dynamic_slice_in_dim(
+                            qs_sg, mc, 1, 0)[0]
+                        emb = transformer.embed(
+                            cfg, head, tok_m[None], pos_m[None]
+                        )
+                        is0 = s == 0
+                        x_in = jnp.where(is0, emb.astype(x.dtype), x)
+                        tbl = jnp.where(valid, tables, 0)
+                        x_out, kv_loc = _stage_run(
+                            cfg, blocks, rope, kv_loc, x_in, pos_m[None],
+                            tbl, c["moe_impl"], c["unroll"],
+                            ragged=(qs_m, q_start, q_len),
+                        )
+                        is_last = s == S - 1
+                        start = mc * seg
+                        cur = jax.lax.dynamic_slice(
+                            acc, (0, start, 0), (1, seg, D))
+                        upd = jnp.where(
+                            jnp.logical_and(valid, is_last), x_out, cur)
+                        acc = jax.lax.dynamic_update_slice(
+                            acc, upd, (0, start, 0))
+                        x_n = jax.lax.ppermute(x_out, "pp", perm)
+                        return (x_n, acc, kv_loc), None
+
+                    (x, acc, kv_loc), _ = jax.lax.scan(
+                        body, (x0, acc0, kv_loc),
+                        jnp.arange(2 * S - 1, dtype=jnp.int32),
+                    )
+                    acc = jax.lax.psum(acc, "pp")
+                    kv_out = jax.tree_util.tree_map(
+                        lambda a: a[None], kv_loc)
+                    return acc, kv_out
+
+                pipe, repl = P("pp"), P()
+                sm = _ring_shard_map(
+                    ring, mesh,
+                    in_specs=(
+                        pipe,
+                        jax.tree_util.tree_map(
+                            lambda _: pipe, params["blocks"]),
+                        jax.tree_util.tree_map(
+                            lambda _: repl, params["head"]),
+                        (repl, repl),
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                        repl, repl, repl, repl, repl, repl,
+                    ),
+                    out_specs=(
+                        repl,
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                    ),
+                    check=c["check_vma"],
+                )
+                hidden, kv = sm(
+                    jnp.arange(S, dtype=jnp.int32),
+                    params["blocks"], params["head"], params["rope"], kv,
+                    tok_sg, pos_sg, qs_sg, tables, q_start, q_len,
+                )
+                kv = _pin_kv(kv, c["kv_sharding"])
+                logits = transformer.head(
+                    cfg, params["head"], hidden[:, :T])
+                key, sub = jax.random.split(key)
+                nxt = sample_traced(
+                    logits[0, last_idx], sub, temperature, top_p,
+                    mode=mode, top_k=top_k,
+                )
+                return nxt.astype(jnp.int32), kv, key
+
+            self._fns[key_] = mixed
+        return self._fns[key_]
+
+    def _decode_fn(self, B: int):
+        """One decode step over the stage ring: lanes split into pp
+        groups of ceil(B/pp) (padding lanes ride zeroed table rows into
+        the trash block), group g enters stage 0 at tick g, the last
+        stage accumulates, psum replicates, and head/sample run outside
+        at the (B, V) base shapes with the base key cadence."""
+        key_ = ("decode", B)
+        if key_ not in self._fns:
+            c = self._ring_consts()
+            cfg, mesh, S = c["cfg"], c["mesh"], c["S"]
+            Bg = -(-B // S)
+            Bp = Bg * S
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            @partial(
+                jax.jit, donate_argnums=(2,),
+                static_argnames=("mode", "top_k"),
+            )
+            def decode(params, tok, kv, tables, input_pos, key,
+                       temperature, top_p, mode, top_k):
+                tok_p = jnp.pad(tok, (0, Bp - B))
+                pos_p = jnp.pad(input_pos, (0, Bp - B))
+                tbl_p = jnp.pad(tables, ((0, Bp - B), (0, 0)))
+
+                def ring(sid, blocks, head, rope, kv, tok_p, pos_p, tbl_p):
+                    s = sid[0]  # stage id arrives as data: axis_index lowers to
+                    # PartitionId, which GSPMD rejects when tp is auto
+                    blocks = jax.tree_util.tree_map(lambda a: a[0], blocks)
+                    kv_loc = jax.tree_util.tree_map(lambda a: a[0], kv)
+                    D = cfg.n_embd
+                    x0 = jnp.zeros((Bg, 1, D), c["x_dtype"])
+                    acc0 = jnp.zeros((Bp, 1, D), c["x_dtype"])
+
+                    def body(carry, t):
+                        x, acc, kv_loc = carry
+                        g = t - s
+                        valid = jnp.logical_and(g >= 0, g < S)
+                        gc = jnp.clip(g, 0, S - 1)
+                        off = gc * Bg
+                        tok_g = jax.lax.dynamic_slice_in_dim(
+                            tok_p, off, Bg)
+                        pos_g = jax.lax.dynamic_slice_in_dim(
+                            pos_p, off, Bg)
+                        tbl_g = jax.lax.dynamic_slice(
+                            tbl_p, (off, 0), (Bg, tbl_p.shape[1]))
+                        pos2 = pos_g[:, None]
+                        emb = transformer.embed(
+                            cfg, head, tok_g[:, None], pos2)
+                        is0 = s == 0
+                        x_in = jnp.where(is0, emb.astype(x.dtype), x)
+                        tbl = jnp.where(valid, tbl_g, 0)
+                        x_out, kv_loc = _stage_run(
+                            cfg, blocks, rope, kv_loc, x_in, pos2, tbl,
+                            c["moe_impl"], c["unroll"],
+                        )
+                        is_last = s == S - 1
+                        cur = jax.lax.dynamic_slice(
+                            acc, (off, 0, 0), (Bg, 1, D))
+                        upd = jnp.where(
+                            jnp.logical_and(valid, is_last), x_out, cur)
+                        acc = jax.lax.dynamic_update_slice(
+                            acc, upd, (off, 0, 0))
+                        x_n = jax.lax.ppermute(x_out, "pp", perm)
+                        return (x_n, acc, kv_loc), None
+
+                    (x, acc, kv_loc), _ = jax.lax.scan(
+                        body, (x0, acc0, kv_loc),
+                        jnp.arange(2 * S - 1, dtype=jnp.int32),
+                    )
+                    acc = jax.lax.psum(acc, "pp")
+                    kv_out = jax.tree_util.tree_map(
+                        lambda a: a[None], kv_loc)
+                    return acc, kv_out
+
+                pipe, repl = P("pp"), P()
+                sm = _ring_shard_map(
+                    ring, mesh,
+                    in_specs=(
+                        pipe,
+                        jax.tree_util.tree_map(
+                            lambda _: pipe, params["blocks"]),
+                        jax.tree_util.tree_map(
+                            lambda _: repl, params["head"]),
+                        (repl, repl),
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                        repl, repl, repl,
+                    ),
+                    out_specs=(
+                        repl,
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                    ),
+                    check=c["check_vma"],
+                )
+                hidden, kv = sm(
+                    jnp.arange(S, dtype=jnp.int32),
+                    params["blocks"], params["head"], params["rope"], kv,
+                    tok_p, pos_p, tbl_p,
+                )
+                kv = _pin_kv(kv, c["kv_sharding"])
+                logits = transformer.head(cfg, params["head"], hidden[:B])
+                key, sub = jax.random.split(key)
+                nxt = sample_traced(
+                    logits[:, -1], sub, temperature, top_p,
+                    mode=mode, top_k=top_k,
+                )
+                return nxt.astype(jnp.int32), kv, key
+
+            self._fns[key_] = decode
+        return self._fns[key_]
+
+    def _verify_fn(self, B: int, T: int):
+        """Batched speculative verify over the stage ring: the (B, T)
+        draft batch group-sweeps the ring exactly like decode, the head +
+        greedy argmax run outside at the base shapes (no RNG — verify is
+        greedy by contract)."""
+        key_ = ("verify", B, T)
+        if key_ not in self._fns:
+            c = self._ring_consts()
+            cfg, mesh, S = c["cfg"], c["mesh"], c["S"]
+            Bg = -(-B // S)
+            Bp = Bg * S
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def verify(params, tokens, kv, tables, pos0):
+                tok_p = jnp.pad(tokens, ((0, Bp - B), (0, 0)))
+                pos_p = jnp.pad(pos0, (0, Bp - B))
+                tbl_p = jnp.pad(tables, ((0, Bp - B), (0, 0)))
+
+                def ring(sid, blocks, head, rope, kv, tok_p, pos_p, tbl_p):
+                    s = sid[0]  # stage id arrives as data: axis_index lowers to
+                    # PartitionId, which GSPMD rejects when tp is auto
+                    blocks = jax.tree_util.tree_map(lambda a: a[0], blocks)
+                    kv_loc = jax.tree_util.tree_map(lambda a: a[0], kv)
+                    D = cfg.n_embd
+                    x0 = jnp.zeros((Bg, T, D), c["x_dtype"])
+                    acc0 = jnp.zeros((Bp, T, D), c["x_dtype"])
+                    ramp = jnp.arange(T, dtype=pos_p.dtype)[None, :]
+
+                    def body(carry, t):
+                        x, acc, kv_loc = carry
+                        g = t - s
+                        valid = jnp.logical_and(g >= 0, g < S)
+                        gc = jnp.clip(g, 0, S - 1)
+                        off = gc * Bg
+                        tok_g = jax.lax.dynamic_slice(
+                            tok_p, (off, 0), (Bg, T))
+                        pos_g = jax.lax.dynamic_slice_in_dim(
+                            pos_p, off, Bg)
+                        tbl_g = jax.lax.dynamic_slice(
+                            tbl_p, (off, 0), (Bg, tbl_p.shape[1]))
+                        pos2 = pos_g[:, None] + ramp
+                        emb = transformer.embed(cfg, head, tok_g, pos2)
+                        is0 = s == 0
+                        x_in = jnp.where(is0, emb.astype(x.dtype), x)
+                        tbl = jnp.where(valid, tbl_g, 0)
+                        x_out, kv_loc = _stage_run(
+                            cfg, blocks, rope, kv_loc, x_in, pos2, tbl,
+                            c["moe_impl"], c["unroll"],
+                        )
+                        is_last = s == S - 1
+                        cur = jax.lax.dynamic_slice(
+                            acc, (off, 0, 0), (Bg, T, D))
+                        upd = jnp.where(
+                            jnp.logical_and(valid, is_last), x_out, cur)
+                        acc = jax.lax.dynamic_update_slice(
+                            acc, upd, (off, 0, 0))
+                        x_n = jax.lax.ppermute(x_out, "pp", perm)
+                        return (x_n, acc, kv_loc), None
+
+                    (x, acc, kv_loc), _ = jax.lax.scan(
+                        body, (x0, acc0, kv_loc),
+                        jnp.arange(2 * S - 1, dtype=jnp.int32),
+                    )
+                    acc = jax.lax.psum(acc, "pp")
+                    kv_out = jax.tree_util.tree_map(
+                        lambda a: a[None], kv_loc)
+                    return acc, kv_out
+
+                pipe, repl = P("pp"), P()
+                sm = _ring_shard_map(
+                    ring, mesh,
+                    in_specs=(
+                        pipe,
+                        jax.tree_util.tree_map(
+                            lambda _: pipe, params["blocks"]),
+                        jax.tree_util.tree_map(
+                            lambda _: repl, params["head"]),
+                        (repl, repl),
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                        repl, repl, repl,
+                    ),
+                    out_specs=(
+                        repl,
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                    ),
+                    check=c["check_vma"],
+                )
+                hidden, kv = sm(
+                    jnp.arange(S, dtype=jnp.int32),
+                    params["blocks"], params["head"], params["rope"], kv,
+                    tok_p, pos_p, tbl_p,
+                )
+                kv = _pin_kv(kv, c["kv_sharding"])
+                logits = transformer.head(cfg, params["head"], hidden[:B])
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+            self._fns[key_] = verify
+        return self._fns[key_]
+
+    def _decode_chunk_fn(self, B: int, K: int):
+        """K decode steps as ONE recurrent ring call — the paper's
+        execution model verbatim: lane-group payloads circle the stages;
+        whenever a payload returns to stage 0 it is sampled, advanced one
+        step (the base engine's limit/stop/freeze masks, applied
+        per-group), re-embedded and relaunched without leaving the
+        device.  K*pp + pp ticks serve K tokens on every lane; the host
+        syncs once, exactly like the base chunked scan, and the
+        double-buffer chain works unchanged off the returned final
+        (token, position) carry.
+
+        RNG: the K per-step subkeys are pre-split outside the ring in the
+        base engine's order (so the returned key state is bit-identical);
+        group g's step k consumes subkey k.  Stochastic per-lane draws
+        under a (Bg,)-shaped sample differ from the base (B,)-shaped one
+        — greedy streams, the serving parity contract, are exact."""
+        key_ = ("decode_chunk", B, K)
+        if key_ not in self._fns:
+            c = self._ring_consts()
+            cfg, mesh, S = c["cfg"], c["mesh"], c["S"]
+            Bg = -(-B // S)
+            Bp = Bg * S
+            n_ticks = K * S + S
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            @partial(
+                jax.jit, donate_argnums=(2,),
+                static_argnames=("mode", "top_k"),
+            )
+            def decode_chunk(params, tok0, kv, tables, pos0, limit,
+                             stop_tok, key, temperature, top_p, mode,
+                             top_k):
+                # pre-split the K step subkeys in the base engine's exact
+                # order so the returned key state matches bit for bit
+                subs = []
+                for _ in range(K):
+                    key, sub = jax.random.split(key)
+                    subs.append(sub)
+                subs = jnp.stack(subs)
+                tok_p = jnp.pad(tok0, (0, Bp - B))
+                pos_p = jnp.pad(pos0, (0, Bp - B))
+                tbl_p = jnp.pad(tables, ((0, Bp - B), (0, 0)))
+                lim_p = jnp.pad(limit, (0, Bp - B))
+                stop_p = jnp.pad(stop_tok, (0, Bp - B), constant_values=-1)
+
+                def ring(sid, blocks, head, rope, kv, tok_p, pos_p, tbl_p,
+                         lim_p, stop_p, subs, temperature, top_p):
+                    s = sid[0]  # stage id arrives as data: axis_index lowers to
+                    # PartitionId, which GSPMD rejects when tp is auto
+                    is0 = s == 0
+                    blocks = jax.tree_util.tree_map(lambda a: a[0], blocks)
+                    kv_loc = jax.tree_util.tree_map(lambda a: a[0], kv)
+                    D = cfg.n_embd
+                    payload0 = (
+                        jnp.zeros((Bg, 1, D), c["x_dtype"]),  # x
+                        jnp.zeros((Bg,), jnp.int32),          # tok
+                        jnp.zeros((Bg,), jnp.int32),          # pos
+                        jnp.zeros((Bg,), jnp.int32),          # done (0/1)
+                        jnp.zeros((1,), jnp.int32),           # step k
+                        jnp.zeros((1,), jnp.int32),           # group g
+                        jnp.zeros((1,), jnp.int32),           # valid (0/1)
+                    )
+                    out0 = jnp.zeros((K, Bp), jnp.int32)
+                    fin_t0 = jnp.zeros((Bp,), jnp.int32)
+                    fin_p0 = jnp.zeros((Bp,), jnp.int32)
+
+                    def body(carry, t):
+                        (x, tok, pos, done, kstep, g, valid), kv_loc, \
+                            out, fin_t, fin_p = carry
+                        # ---- stage 0, returning payload: head + sample
+                        # + one decode-step advance (base masks) ----
+                        returning = jnp.logical_and(
+                            jnp.logical_and(is0, t >= S), valid[0] > 0)
+                        logits = transformer.head(cfg, head, x)[:, -1]
+                        kidx = jnp.clip(kstep[0], 0, K - 1)
+                        samp = sample_traced(
+                            logits, subs[kidx], temperature, top_p,
+                            mode=mode, top_k=top_k,
+                        ).astype(jnp.int32)
+                        off = g[0] * Bg
+                        lim_g = jax.lax.dynamic_slice_in_dim(
+                            lim_p, off, Bg)
+                        stop_g = jax.lax.dynamic_slice_in_dim(
+                            stop_p, off, Bg)
+                        active = jnp.logical_and(
+                            jnp.logical_and(returning, kidx < lim_g),
+                            done == 0,
+                        )
+                        nxt = jnp.where(active, samp, tok)
+                        done = jnp.where(
+                            jnp.logical_and(active, nxt == stop_g),
+                            1, done,
+                        )
+                        pos = pos + active.astype(pos.dtype)
+                        # record row kstep for the group (frozen lanes
+                        # record their held token, mirroring the base
+                        # scan; the host drains only up to each limit)
+                        cur = jax.lax.dynamic_slice(
+                            out, (kidx, off), (1, Bg))
+                        rec = jnp.where(returning, nxt, cur[0])
+                        out = jax.lax.dynamic_update_slice(
+                            out, rec[None], (kidx, off))
+                        k2 = jnp.where(returning, kstep + 1, kstep)
+                        finishing = jnp.logical_and(returning, k2[0] >= K)
+                        cur_t = jax.lax.dynamic_slice_in_dim(
+                            fin_t, off, Bg)
+                        fin_t = jax.lax.dynamic_update_slice(
+                            fin_t, jnp.where(finishing, nxt, cur_t),
+                            (off,),
+                        )
+                        cur_p = jax.lax.dynamic_slice_in_dim(
+                            fin_p, off, Bg)
+                        fin_p = jax.lax.dynamic_update_slice(
+                            fin_p, jnp.where(finishing, pos, cur_p),
+                            (off,),
+                        )
+                        valid2 = jnp.where(
+                            finishing, jnp.zeros_like(valid), valid)
+                        # ---- stage 0, fill phase: inject group t ----
+                        inject = jnp.logical_and(is0, t < S)
+                        off_inj = jnp.clip(t, 0, S - 1) * Bg
+                        tok_inj = jax.lax.dynamic_slice_in_dim(
+                            tok_p, off_inj, Bg)
+                        pos_inj = jax.lax.dynamic_slice_in_dim(
+                            pos_p, off_inj, Bg)
+                        tok3 = jnp.where(inject, tok_inj, nxt)
+                        pos3 = jnp.where(inject, pos_inj, pos)
+                        done3 = jnp.where(inject, jnp.zeros_like(done),
+                                          done)
+                        k3 = jnp.where(inject, jnp.zeros_like(k2), k2)
+                        g3 = jnp.where(
+                            inject, jnp.clip(t, 0, S - 1)[None], g)
+                        valid3 = jnp.where(
+                            inject, jnp.ones_like(valid2), valid2)
+                        launch = jnp.logical_or(inject, returning)
+                        emb = transformer.embed(
+                            cfg, head, tok3[:, None], pos3[:, None])
+                        x3 = jnp.where(launch, emb.astype(x.dtype), x)
+                        # ---- this stage's blocks over the payload ----
+                        off_run = g3[0] * Bg
+                        tbl_g = jax.lax.dynamic_slice(
+                            tbl_p, (off_run, 0), (Bg, tbl_p.shape[1]))
+                        tbl = jnp.where(valid3[0] > 0, tbl_g, 0)
+                        x4, kv_loc = _stage_run(
+                            cfg, blocks, rope, kv_loc, x3, pos3[:, None],
+                            tbl, c["moe_impl"], c["unroll"],
+                        )
+                        # ---- hand the payload to the next stage ----
+                        pay = tuple(
+                            jax.lax.ppermute(a, "pp", perm)
+                            for a in (x4, tok3, pos3, done3, k3, g3,
+                                      valid3)
+                        )
+                        return (pay, kv_loc, out, fin_t, fin_p), None
+
+                    (pay, kv_loc, out, fin_t, fin_p), _ = jax.lax.scan(
+                        body, (payload0, kv_loc, out0, fin_t0, fin_p0),
+                        jnp.arange(n_ticks, dtype=jnp.int32),
+                    )
+                    out = jax.lax.psum(out, "pp")
+                    fin_t = jax.lax.psum(fin_t, "pp")
+                    fin_p = jax.lax.psum(fin_p, "pp")
+                    kv_out = jax.tree_util.tree_map(
+                        lambda a: a[None], kv_loc)
+                    return out, fin_t, fin_p, kv_out
+
+                pipe, repl = P("pp"), P()
+                sm = _ring_shard_map(
+                    ring, mesh,
+                    in_specs=(
+                        pipe,
+                        jax.tree_util.tree_map(
+                            lambda _: pipe, params["blocks"]),
+                        jax.tree_util.tree_map(
+                            lambda _: repl, params["head"]),
+                        (repl, repl),
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                        repl, repl, repl, repl, repl, repl, repl, repl,
+                    ),
+                    out_specs=(
+                        repl, repl, repl,
+                        jax.tree_util.tree_map(lambda _: pipe, kv),
+                    ),
+                    check=c["check_vma"],
+                )
+                toks, fin_t, fin_p, kv = sm(
+                    jnp.arange(S, dtype=jnp.int32),
+                    params["blocks"], params["head"], params["rope"], kv,
+                    tok_p, pos_p, tbl_p, lim_p, stop_p, subs,
+                    temperature, top_p,
+                )
+                kv = _pin_kv(kv, c["kv_sharding"])
+                return toks[:, :B], fin_t[:B], fin_p[:B], kv, key
+
+            self._fns[key_] = decode_chunk
+        return self._fns[key_]

@@ -253,17 +253,21 @@ class Scheduler:
             self.observer.request_finished(seq.req.rid)
 
     def preempt_latest(self, exclude: Optional[SequenceState] = None) -> bool:  # mdi-thread: engine
-        """Recompute-style preemption: kick the most recently admitted
-        sequence back to the queue (its tokens re-prefill on resume)."""
+        """Recompute-style preemption: kick the lowest-priority lane back
+        to the queue (its tokens re-prefill on resume).  Within a priority
+        class the most recently ADMITTED sequence yields (not the highest
+        slot index — slots churn): the newest sequence has the least
+        paid-for KV to recompute.  Under plain FCFS every lane has
+        priority 0 and this reduces to the pure recency rule, so pool
+        pressure can never evict a high-priority stream to keep a
+        low-priority one decoding (priority inversion)."""
         victims = [s for s in self.running() if s is not exclude]
         if not victims:
             # fall back to self-preemption: the caller's own sequence yields
             victims = self.running()
         if not victims:
             return False
-        # most recently ADMITTED (not highest slot index — slots churn):
-        # the newest sequence has the least paid-for KV to recompute
-        seq = max(victims, key=lambda s: s.admit_order)
+        seq = min(victims, key=lambda s: (s.req.priority, -s.admit_order))
         self.slots[seq.slot] = None
         self.pool.release(seq.blocks)
         seq.blocks = []

@@ -1,0 +1,387 @@
+"""Pipeline-parallel serving: the recurrent stage ring under the
+continuous-batching engine must reproduce the single-device engine — and
+sequential `Generator.generate` — token-for-token across every serving
+feature (unified mixed steps, chunked decode, speculative verify,
+preemption/resume, prefix caching), with the host-sync cadence
+bit-identical, zero post-warmup recompiles, and per-stage pool shards
+whose bytes match mdi-audit's static estimate exactly.
+
+The ring is a manual-pp shard_map region, so these tests run wherever
+either shard_map generation exists (`jax.shard_map`, or the experimental
+one on older builds — pp-only rings are fully manual and work on both).
+Composing tp x pp needs the modern API: on old builds the engine refuses
+actionably and the composed parity test skips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import init_params
+from mdi_llm_tpu.parallel.mesh import make_mesh
+from mdi_llm_tpu.serving.pipeline import PipelinedServingEngine, _shard_map_api
+from mdi_llm_tpu.utils.profiling import CompileGuard
+from tests.test_model import tiny_config
+
+HAS_RING = _shard_map_api() is not None
+NEW_API = _shard_map_api() == "new"
+
+ring = pytest.mark.skipif(
+    not HAS_RING,
+    reason="no shard_map in this jax build (the stage ring cannot run)",
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def single_gen(model):
+    cfg, params = model
+    return Generator(cfg, params, cache_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def pp_gen(model, devices):
+    cfg, params = model
+    return Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"pp": 2}, devices[:2]),
+    )
+
+
+def _trace(cfg, lengths, seed=5):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, int(n)).tolist() for n in lengths]
+
+
+def _run_engine(gen, prompts, max_news, **knobs):
+    engine = gen.serve(**knobs)
+    for i, (p, m) in enumerate(zip(prompts, max_news)):
+        engine.add_request(f"r{i}", p, m)
+    results, stats = engine.run()
+    return results, stats, engine
+
+
+def _sequential_greedy(gen, prompts, max_news):
+    return [
+        gen.generate([p], m, temperature=0.0)[0][0]
+        for p, m in zip(prompts, max_news)
+    ]
+
+
+@ring
+@pytest.mark.smoke
+def test_pp_engine_matches_single_engine_and_generate(model, single_gen,
+                                                      pp_gen):
+    """The acceptance contract: a mixed-length trace whose 33-token prompt
+    splits across several unified mixed steps — the staged engine's
+    streams equal BOTH the single-device engine's and sequential
+    generate()'s, and the host-sync cadence is IDENTICAL (same step
+    counts: the ring changes device math only, never dispatch)."""
+    cfg, _ = model
+    prompts = _trace(cfg, (3, 9, 17, 5, 33))
+    max_news = [8, 12, 6, 10, 7]
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=16, token_budget=12)
+    want_gen = _sequential_greedy(single_gen, prompts, max_news)
+    want, base_stats, _ = _run_engine(single_gen, prompts, max_news, **knobs)
+    got, stats, engine = _run_engine(pp_gen, prompts, max_news, **knobs)
+    for i in range(len(prompts)):
+        assert got[f"r{i}"] == want[f"r{i}"], f"r{i} diverged from engine"
+        assert got[f"r{i}"] == want_gen[i], f"r{i} diverged from generate()"
+    # host-sync cadence parity, not just token parity
+    assert stats.mixed_steps == base_stats.mixed_steps
+    assert stats.decode_steps == base_stats.decode_steps
+    assert stats.host_syncs == base_stats.host_syncs
+    assert stats.requests_finished == len(prompts)
+    assert isinstance(engine, PipelinedServingEngine)
+    assert engine.n_stages == 2
+    # the pool really is staged: leading stage axis laid out over pp
+    assert "pp" in str(engine._kv["k"].sharding.spec)
+    assert engine.pool.used == 0
+
+
+@ring
+@pytest.mark.parametrize("chunk,buffered", [(4, True), (8, False)],
+                         ids=["k4-buffered", "k8-nobuf"])
+def test_pp_chunked_decode_token_identical(model, single_gen, pp_gen,
+                                           chunk, buffered):
+    """The recurrent ring proper: K decode steps circle the stages in ONE
+    jitted call (relaunch-on-return), double-buffered or not —
+    token-identical, same sync amortization as the flat engine."""
+    cfg, _ = model
+    prompts = _trace(cfg, (3, 9, 17))
+    max_news = [8, 12, 6]
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=8,
+                 decode_chunk=chunk, double_buffer=buffered)
+    want, base_stats, _ = _run_engine(single_gen, prompts, max_news, **knobs)
+    got, stats, _ = _run_engine(pp_gen, prompts, max_news, **knobs)
+    assert got == want
+    assert stats.host_syncs == base_stats.host_syncs
+    assert stats.tokens_per_sync > 1.0
+
+
+@ring
+def test_pp_speculative_serving_token_identical(model, single_gen, pp_gen):
+    """Batched n-gram speculative verify rides the ring's grouped sweep
+    and stays exact — drafts still accept."""
+    cyc = [np.random.default_rng(s).integers(1, tiny_config().vocab_size,
+                                             5).tolist() for s in (5, 7, 0)]
+    max_news = [30, 25, 20]
+    knobs = dict(block_size=4, max_batch=3, decode_chunk=4, spec_k=4)
+    want, _, _ = _run_engine(single_gen, cyc, max_news, **knobs)
+    got, stats, _ = _run_engine(pp_gen, cyc, max_news, **knobs)
+    assert got == want
+    assert stats.spec_drafted > 0 and stats.spec_accepted > 0
+
+
+@ring
+def test_pp_preemption_resume_parity(model, single_gen, pp_gen):
+    """A pool sized to force recompute preemption: victims resume and
+    re-feed through the staged mixed step, outputs exact, every stage's
+    pool shard drained."""
+    cfg, _ = model
+    prompts = _trace(cfg, (9, 13, 11), seed=9)
+    max_news = [10, 10, 10]
+    knobs = dict(block_size=4, max_batch=3, max_blocks=1 + 10,
+                 prefix_caching=False, decode_chunk=4)
+    want, _, _ = _run_engine(single_gen, prompts, max_news, **knobs)
+    got, stats, engine = _run_engine(pp_gen, prompts, max_news, **knobs)
+    assert stats.preemptions >= 1, "pool was sized to force preemption"
+    assert got == want
+    assert engine.pool.used == 0
+
+
+@ring
+def test_pp_prefix_cache_hits_parity(model, single_gen, pp_gen):
+    """Copy-free prefix reuse under pp: a block id indexes every stage's
+    shard at once, so reuse moves no bytes on ANY stage — hits fire and
+    the output matches the sequential run."""
+    cfg, _ = model
+    head = _trace(cfg, (21,), seed=7)[0]
+    engine = pp_gen.serve(block_size=4, max_batch=2)
+    engine.add_request("first", head, 6)
+    engine.run()
+    tail = head + [7, 8]
+    engine.add_request("second", tail, 6)
+    results, stats = engine.run()
+    assert stats.prefix_cache_hits >= 5  # 21-token head -> 5 full blocks
+    assert results["second"] == _sequential_greedy(single_gen, [tail], [6])[0]
+
+
+@pytest.mark.skipif(not NEW_API, reason=(
+    "composed tp x pp needs the modern jax.shard_map (partial-auto rings "
+    "crash this older XLA's SPMD partitioner)"))
+def test_tp_pp_composed_token_identical(model, single_gen, devices):
+    """tp=2 x pp=2 on 4 devices: the ring stays manual over pp while
+    GSPMD lays out each stage's matmuls over tp — streams still exact."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"tp": 2, "pp": 2}, devices[:4]))
+    prompts = _trace(cfg, (3, 9, 17))
+    max_news = [6, 8, 5]
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=16,
+                 token_budget=12, decode_chunk=4)
+    want, _, _ = _run_engine(single_gen, prompts, max_news, **knobs)
+    got, _, engine = _run_engine(gen, prompts, max_news, **knobs)
+    assert got == want
+    spec = str(engine._kv["k"].sharding.spec)
+    assert "pp" in spec and "tp" in spec
+
+
+@pytest.mark.skipif(NEW_API, reason=(
+    "modern jax.shard_map present: composed tp x pp is supported, the "
+    "old-build refusal gate does not apply"))
+def test_tp_pp_composed_refused_on_old_shard_map(model, devices):
+    """On builds with only the experimental shard_map, composing tp with
+    pp must refuse AT ENGINE CONSTRUCTION with the upgrade path named —
+    the partial-auto ring would abort the whole process inside XLA."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"tp": 2, "pp": 2}, devices[:4]))
+    with pytest.raises(ValueError, match="composed tp x pp"):
+        gen.serve(block_size=4, max_batch=2)
+
+
+def test_pp_serve_routing_and_refusals(model, devices):
+    """Generator.serve() routes pp>=2 meshes to the pipelined engine;
+    unsupported axes and the kernel path refuse actionably at serve
+    time."""
+    cfg, params = model
+    # dp alongside pp: refused, axis named
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"dp": 2, "pp": 2}, devices[:4]))
+    with pytest.raises(ValueError, match="dp"):
+        gen.serve(block_size=4, max_batch=2)
+    if not HAS_RING:
+        return
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"pp": 2}, devices[:2]))
+    # Pallas kernels are not wired through the ring
+    with pytest.raises(ValueError, match="use_kernel"):
+        gen.serve(block_size=4, max_batch=2, use_kernel=True)
+    engine = gen.serve(block_size=4, max_batch=2)
+    assert isinstance(engine, PipelinedServingEngine)
+    fill = engine.pipeline_fill()
+    assert fill["stages"] == 2 and fill["lanes"] == 2
+    assert fill["bubble_fraction"] == 0.0
+    assert sum(fill["stage_layers"]) == cfg.n_layer
+
+
+def test_pp_stage_split_refused_when_too_few_layers(model, devices):
+    """More stages than layers cannot split: the engine refuses with the
+    layer arithmetic spelled out (stage_layers' actionable error)."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"pp": 4}, devices[:4]))
+    with pytest.raises(ValueError, match="cannot split 3 layers over 4"):
+        gen.serve(block_size=4, max_batch=4)
+
+
+@ring
+def test_pp_pool_bytes_match_audit_estimate(model, pp_gen, devices):
+    """mdi-audit's per-stage pool estimate must equal the LIVE staged
+    pool byte-for-byte: the analytic total, the per-stage share, and the
+    bytes actually resident on one stage's device."""
+    from mdi_llm_tpu.analysis.audit import preflight
+    from mdi_llm_tpu.config import ServingConfig
+
+    cfg, _ = model
+    sv = ServingConfig(block_size=4, max_batch=3, prefill_chunk=8)
+    report = preflight(cfg, pp=2, batch=3, seq_len=128,
+                       cache_dtype="float32", serving=sv)
+    assert not report.errors
+    pool = report.breakdown["kv_pool"]
+    engine = pp_gen.serve(serving=sv)
+    leaves = jax.tree_util.tree_leaves(engine._kv)
+    live_total = sum(int(x.nbytes) for x in leaves)
+    dev0 = devices[0]
+    live_dev = sum(
+        int(s.data.nbytes)
+        for x in leaves for s in x.addressable_shards if s.device == dev0
+    )
+    assert pool["pp"] == 2
+    assert pool["stage_layers"] == [1, 2]
+    assert pool["pool_bytes"] == live_total
+    assert pool["pool_bytes_per_stage"] == live_total // 2 == live_dev
+    assert pool["pool_bytes_per_device"] == live_dev
+    # the per-device HBM budget line uses the staged number too
+    assert report.breakdown["per_device"]["kv_bytes"] == live_dev
+
+
+def test_audit_flags_pipeline_underfill_and_bad_stage_split():
+    """Static twins of the runtime behavior: max_batch < pp warns with
+    the bubble fraction; pp > n_layer is a bad-serving-mesh error."""
+    from mdi_llm_tpu.analysis.audit import audit_plan
+    from mdi_llm_tpu.analysis.plan import MeshSpec, PlanSpec
+    from mdi_llm_tpu.config import ServingConfig
+
+    cfg = tiny_config(block_size=128)  # n_layer=3
+    r = audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"pp": 2}),
+        serving=ServingConfig(block_size=4, max_batch=1),
+    ))
+    under = [f for f in r.findings if f.rule == "pipeline-underfill"]
+    assert under and "50%" in under[0].message
+    ringinfo = r.breakdown["serving_ring"]
+    assert ringinfo["stages"] == 2 and ringinfo["lanes"] == 1
+    assert ringinfo["bubble_fraction"] == 0.5
+
+    # saturated plan: no underfill finding
+    r = audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"pp": 2}),
+        serving=ServingConfig(block_size=4, max_batch=4),
+    ))
+    assert not [f for f in r.findings if f.rule == "pipeline-underfill"]
+
+    # unstageable split: pp exceeds layers
+    r = audit_plan(PlanSpec(
+        cfg=cfg, mesh=MeshSpec.from_dict({"pp": 8}),
+        serving=ServingConfig(block_size=4, max_batch=8),
+    ))
+    assert any(f.rule == "bad-serving-mesh" and "pp=8" in f.message
+               for f in r.findings)
+
+
+def test_preempt_latest_kicks_lowest_priority_not_newest():
+    """Priority-inversion guard under pool pressure: preemption victims
+    are chosen lowest-priority-first, recency only breaking ties — a
+    high-priority stream admitted LAST must survive while the older
+    low-priority lane yields."""
+    from mdi_llm_tpu.serving.kv_pool import KVPool
+    from mdi_llm_tpu.serving.scheduler import Request, Scheduler
+
+    pool = KVPool(32, 4)
+    sched = Scheduler(pool, max_batch=3, prefill_chunk=8, max_seq_length=64)
+    sched.add(Request(rid="low", prompt=[1] * 6, max_new_tokens=4,
+                      priority=0))
+    sched.add(Request(rid="high", prompt=[2] * 6, max_new_tokens=4,
+                      priority=5))
+    kind, _ = sched.next_batch(32)  # admits both (FCFS: low first)
+    assert kind == "mixed"
+    running = {s.req.rid: s for s in sched.running()}
+    assert set(running) == {"low", "high"}
+    # the high-priority lane is the NEWEST admission — the old pure
+    # recency rule would have evicted it here
+    assert running["high"].admit_order > running["low"].admit_order
+    assert sched.preempt_latest()
+    assert [s.req.rid for s in sched.running()] == ["high"]
+    assert sched.preempted and sched.preempted[0][0].rid == "low"
+    # within one priority class the rule reduces to recency: the newest
+    # equal-priority lane yields (least paid-for KV to recompute)
+    pool2 = KVPool(32, 4)
+    sched2 = Scheduler(pool2, max_batch=2, prefill_chunk=8,
+                       max_seq_length=64)
+    sched2.add(Request(rid="a", prompt=[1] * 6, max_new_tokens=4,
+                       priority=5))
+    sched2.add(Request(rid="b", prompt=[2] * 6, max_new_tokens=4,
+                       priority=5))
+    sched2.next_batch(32)
+    assert sched2.preempt_latest()
+    assert [s.req.rid for s in sched2.running()] == ["a"]
+    assert sched2.preempted[0][0].rid == "b"
+
+
+@ring
+def test_pp_engine_zero_postwarmup_recompiles(model, devices):
+    """The acceptance criterion's CompileGuard half: a warmup engine and
+    its timed twin on ONE pp Generator share the ring jit cache, and the
+    timed run neither re-traces nor re-compiles — the staged pool pin
+    survives donation round-trips."""
+    cfg, params = model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32,
+                    mesh=make_mesh({"pp": 2}, devices[:2]))
+    prompts = _trace(cfg, (3, 9, 17))
+    knobs = dict(block_size=4, max_batch=3, prefill_chunk=8, decode_chunk=4)
+
+    def drive(engine):
+        for i, p in enumerate(prompts):
+            engine.add_request(f"r{i}", p, 8)
+        engine.run()
+
+    guard = CompileGuard(label="pp-serve")
+    with guard:
+        drive(gen.serve(**knobs))
+        guard.mark_warm()
+        drive(gen.serve(**knobs))
+    assert guard.traces_after_warmup == 0
+    assert guard.backend_compiles_after_warmup == 0
+    guard.expect_clean()
+
+
+def test_cli_help_covers_pp_flags():
+    """Both serving front-ends and the benchmark document the new
+    pipeline-parallel knob."""
+    import bench
+    from mdi_llm_tpu.cli.serve import build_parser as serve_parser
+
+    serve_help = serve_parser().format_help()
+    assert "--pp" in serve_help and "pipeline-parallel" in serve_help
+    bench_help = bench.build_parser().format_help()
+    assert "--pp" in bench_help and "pipeline" in bench_help
