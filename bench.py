@@ -841,6 +841,7 @@ def run_serve(args):
             "pool_mib": args.serve_pool_mib,
             "quantize": args.quantize,
         },
+        "kernel": engine.kernel_info(),
         "device": device_block,
     })
     if args.pp > 1:
@@ -1009,6 +1010,7 @@ def run_serve_open(args):
                 "kv_dtype": warm.kv_dtype_name,
                 "admission_queue": serving_cfg.resolved_admission_queue(),
             },
+            "kernel": warm.kernel_info(),
         },
     }
 
@@ -1029,6 +1031,7 @@ def run_kernel(args):
     from mdi_llm_tpu.config import Config
     from mdi_llm_tpu.ops.attention import multihead_attention
     from mdi_llm_tpu.ops.paged_attention import paged_attention, paged_prefill
+    from mdi_llm_tpu.ops.tuning import DEFAULT_PARAMS, resolve_kernel_params
 
     cfg = Config.from_name(args.model)
     H, G, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
@@ -1091,19 +1094,19 @@ def run_kernel(args):
     q_len = jnp.full((B,), 2, jnp.int32)
     posp = jnp.asarray(np.tile([S - 2, S - 1], B), jnp.int32)
 
-    def attn(pools, use_kernel):
+    def attn(pools, use_kernel, params=None):
         k_pool, v_pool = pools
         return {
             "decode": lambda: timed(jax.jit(partial(
-                paged_attention, use_kernel=use_kernel,
+                paged_attention, use_kernel=use_kernel, params=params,
             )), q1, k_pool, v_pool, tables, pos1),
             "ragged": lambda: timed(jax.jit(partial(
-                paged_attention, use_kernel=use_kernel,
+                paged_attention, use_kernel=use_kernel, params=params,
             )), qr, k_pool, v_pool, tables, posr),
             "prefill": lambda: timed(
                 jax.jit(lambda q, kp, vp, t: paged_prefill(
                     q, kp, vp, t, q_slot, q_start, q_len, posp,
-                    use_kernel=use_kernel,
+                    use_kernel=use_kernel, params=params,
                 )), qp, k_pool, v_pool, tables,
             ),
         }
@@ -1125,15 +1128,43 @@ def run_kernel(args):
         ),
     }
 
+    device_kind = jax.devices()[0].device_kind if on_tpu else None
+    tuning = {}
+    for tag in ("fp", "int8"):
+        params, meta = resolve_kernel_params(
+            n_head=H, n_groups=G, head_size=hs, block_size=BS,
+            kv_dtype="int8" if tag == "int8" else None,
+            device_kind=device_kind,
+        )
+        tuning[tag] = {
+            "tuned": meta["tuned"], "table_source": meta["table_source"],
+            "key": meta["key"], "params": params.to_dict(),
+            "default_params": DEFAULT_PARAMS.to_dict(),
+            "_resolved": params,
+        }
+
     grid = {}
     for tag, pools in (("fp", pool_fp), ("int8", pool_q8)):
+        tuned_params = tuning[tag]["_resolved"]
         for op in ("decode", "ragged", "prefill"):
             row = {
                 "fallback_us": attn(pools, False)[op](),
                 "dense_us": dense_fns[op]() if tag == "fp" else None,
-                "kernel_us": attn(pools, True)[op]() if on_tpu else None,
+                "kernel_us": (
+                    attn(pools, True, tuned_params)[op]() if on_tpu else None
+                ),
+                "kernel_default_us": (
+                    attn(pools, True, DEFAULT_PARAMS)[op]()
+                    if on_tpu and tuning[tag]["tuned"] else None
+                ),
             }
+            if row["kernel_us"] and row["kernel_default_us"]:
+                row["tuned_speedup"] = round(
+                    row["kernel_default_us"] / row["kernel_us"], 3
+                )
             grid[f"{op}-{tag}"] = row
+    for tag in tuning:
+        del tuning[tag]["_resolved"]
     _mark_warm()
 
     value = grid["decode-fp"]["kernel_us"] or grid["decode-fp"]["fallback_us"]
@@ -1147,6 +1178,7 @@ def run_kernel(args):
         "vs_baseline": 1.0,
         "detail": {
             "grid": grid,
+            "tuning": tuning,
             "shapes": {
                 "batch": B, "seq": S, "block_size": BS, "heads": H,
                 "groups": G, "head_size": hs, "ragged_tq": Tq,

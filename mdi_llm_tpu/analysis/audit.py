@@ -111,6 +111,11 @@ AUDIT_RULES: Dict[str, Tuple[str, str]] = {
         "queue bound rejects everything, or it keeps every slot occupied "
         "over a pool too small to hold all slots' reservation headroom "
         "(sustained preemption thrash)"),
+    "bad-kernel-tuning": (
+        ERROR, "a ragged-kernel tuning-table entry cannot run on this "
+        "config/device: kv_step does not divide block_size, q_pack does "
+        "not divide n_query_groups, or the VMEM scratch estimate exceeds "
+        "the device budget (obs/roofline.device_vmem_bytes)"),
 }
 
 GiB = float(1 << 30)
@@ -780,6 +785,79 @@ def _check_serving_mesh(plan: PlanSpec, findings: List[Finding]) -> None:
         ))
 
 
+def _check_kernel_tuning(plan: PlanSpec, findings, breakdown, bb) -> None:
+    """Validate the unified ragged-kernel tuning entry the engine's
+    dispatch would resolve (ops/tuning.py), HOST-side, before anything
+    compiles: an entry whose kv_step does not divide block_size or whose
+    VMEM scratch estimate exceeds the device budget errors here instead
+    of failing (or worse, mis-running) at trace time.  Findings only fire
+    when the kernel can actually be on the route — use_kernel=True, or a
+    user tuning table supplying the entry; a CPU-fallback plan with the
+    committed defaults never trips over a kernel it will not run.  The
+    kv_pool breakdown always gains the route/provenance fields."""
+    from mdi_llm_tpu.obs.roofline import device_vmem_bytes
+    from mdi_llm_tpu.ops.tuning import (
+        estimate_kernel_vmem,
+        resolve_kernel_params,
+        validate_kernel_params,
+    )
+
+    sv = plan.serving
+    cfg = plan.cfg
+    kv_kind = "int8" if bb["kv_dtype"] == "int8" else None
+    variant = (
+        "unified" if sv.use_kernel
+        else ("fallback" if sv.use_kernel is False else "auto")
+    )
+    try:
+        params, meta = resolve_kernel_params(
+            n_head=cfg.n_head, n_groups=cfg.n_query_groups,
+            head_size=cfg.head_size, block_size=sv.block_size,
+            kv_dtype=kv_kind,
+        )
+    except Exception as e:  # unreadable/malformed MDI_TUNE_TABLE artifact
+        findings.append(_finding(
+            plan, "bad-kernel-tuning",
+            f"the kernel tuning table cannot be read: {e} — fix or unset "
+            "MDI_TUNE_TABLE",
+        ))
+        breakdown["kv_pool"].update({
+            "kernel_variant": variant, "tuned": False,
+            "kernel_table_source": None, "kernel_params": None,
+        })
+        return
+    breakdown["kv_pool"].update({
+        "kernel_variant": variant,
+        "tuned": meta["tuned"],
+        "kernel_table_source": meta["table_source"],
+        "kernel_params": params.to_dict(),
+    })
+    if not (sv.use_kernel or meta["tuned"]):
+        return
+    src = meta["table_source"]
+    for p in validate_kernel_params(
+        params, sv.block_size, cfg.n_query_groups, cfg.head_size
+    ):
+        findings.append(_finding(
+            plan, "bad-kernel-tuning", f"{src} ({meta['key']}): {p}",
+        ))
+    vmem = estimate_kernel_vmem(
+        cfg.n_head, cfg.n_query_groups, cfg.head_size,
+        n_tokens=sv.resolved_token_budget(), block_size=sv.block_size,
+        params=params, kv_dtype=kv_kind,
+    )
+    budget = device_vmem_bytes(None)
+    if vmem > budget:
+        findings.append(_finding(
+            plan, "bad-kernel-tuning",
+            f"{src} ({meta['key']}): kernel VMEM estimate "
+            f"{vmem / (1 << 20):.1f} MiB exceeds the device budget "
+            f"{budget / (1 << 20):.1f} MiB at token_budget="
+            f"{sv.resolved_token_budget()} — shrink scratch_width/"
+            "kv_step in the tuning entry, or lower the token budget",
+        ))
+
+
 def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
     sv = plan.serving
     if sv is None:
@@ -916,6 +994,7 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             # bad-server-config checker sized it against the headroom
             "admission_queue": sv.admission_queue,
         }
+        _check_kernel_tuning(plan, findings, breakdown, bb)
         pp = _serving_pp(plan)
         if pp > 1 and plan.cfg.n_layer >= pp:
             from mdi_llm_tpu.parallel.partition import stage_layers

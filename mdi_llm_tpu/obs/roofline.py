@@ -39,8 +39,11 @@ from mdi_llm_tpu.config import Config, ServingConfig, dtype_bytes
 
 __all__ = [
     "DEVICE_PEAKS",
+    "DEVICE_VMEM_BYTES",
     "XLA_AGREEMENT_RTOL",
+    "normalize_device_kind",
     "device_peaks",
+    "device_vmem_bytes",
     "decode_flops_per_token",
     "prefill_flops_per_token",
     "decode_hbm_bytes_per_token",
@@ -76,24 +79,56 @@ ASSUMED_TRAIN_PEAK_KIND = "v5e"
 XLA_AGREEMENT_RTOL = 0.25
 
 
-def device_peaks(device_kind: Optional[str]) -> Optional[Dict[str, float]]:
-    """Map a `jax.Device.device_kind` string to its peak row, or None for
-    kinds the table does not know (CPU, GPU, future TPUs) — callers must
-    treat None as "report null utilization", never assume a chip."""
+# Per-core VMEM budgets by TPU generation, for the ragged paged-attention
+# kernel's tuning-table validation (ops/tuning.py, mdi-audit's
+# bad-kernel-tuning): a tuning entry whose scratch estimate exceeds THIS
+# refuses before any compile.  Every current generation ships ~16 MiB of
+# VMEM per core; unknown kinds use the table minimum — conservative,
+# never a guess.
+DEVICE_VMEM_BYTES: Dict[str, int] = {
+    "v4": 16 * (1 << 20),
+    "v5e": 16 * (1 << 20),
+    "v5p": 16 * (1 << 20),
+    "v6e": 16 * (1 << 20),
+}
+
+
+def normalize_device_kind(device_kind: Optional[str]) -> Optional[str]:
+    """Map a `jax.Device.device_kind` string to its canonical generation
+    key (the DEVICE_PEAKS / DEVICE_VMEM_BYTES / tuning-table key), or None
+    for kinds the tables do not know (CPU, GPU, future TPUs)."""
     if not device_kind:
         return None
     kind = str(device_kind).lower()
     if "v6" in kind:  # "TPU v6 lite" / "TPU v6e" — only the e variant exists
-        return DEVICE_PEAKS["v6e"]
+        return "v6e"
     if "v5p" in kind:
-        return DEVICE_PEAKS["v5p"]
+        return "v5p"
     if "v5e" in kind or "v5 lite" in kind or "v5lite" in kind:
-        return DEVICE_PEAKS["v5e"]
+        return "v5e"
     if "v5" in kind:  # bare "TPU v5" is how v5p reports itself
-        return DEVICE_PEAKS["v5p"]
+        return "v5p"
     if "v4" in kind:
-        return DEVICE_PEAKS["v4"]
+        return "v4"
     return None
+
+
+def device_peaks(device_kind: Optional[str]) -> Optional[Dict[str, float]]:
+    """Map a `jax.Device.device_kind` string to its peak row, or None for
+    kinds the table does not know (CPU, GPU, future TPUs) — callers must
+    treat None as "report null utilization", never assume a chip."""
+    norm = normalize_device_kind(device_kind)
+    return DEVICE_PEAKS[norm] if norm else None
+
+
+def device_vmem_bytes(device_kind: Optional[str] = None) -> int:
+    """The per-core VMEM budget for `device_kind`; unknown/None kinds get
+    the table minimum.  Unlike `device_peaks` this never returns None — it
+    bounds a compile-refusing check, so a conservative floor beats null."""
+    norm = normalize_device_kind(device_kind)
+    if norm:
+        return DEVICE_VMEM_BYTES[norm]
+    return min(DEVICE_VMEM_BYTES.values())
 
 
 def _linear_flops_per_token(cfg: Config) -> float:

@@ -3,7 +3,9 @@
 from mdi_llm_tpu.ops.rope import build_rope_cache, apply_rope
 from mdi_llm_tpu.ops.norms import rms_norm, layer_norm
 from mdi_llm_tpu.ops.attention import multihead_attention
-from mdi_llm_tpu.ops.paged_attention import paged_attention, paged_update
+from mdi_llm_tpu.ops.paged_attention import paged_attention, paged_prefill, paged_update
+from mdi_llm_tpu.ops.ragged_paged_attention import ragged_paged_attention
+from mdi_llm_tpu.ops.tuning import KernelParams, resolve_kernel_params
 from mdi_llm_tpu.ops.sampling import sample, sample_top_p, logits_to_probs
 
 __all__ = [
@@ -13,7 +15,11 @@ __all__ = [
     "layer_norm",
     "multihead_attention",
     "paged_attention",
+    "paged_prefill",
     "paged_update",
+    "ragged_paged_attention",
+    "KernelParams",
+    "resolve_kernel_params",
     "sample",
     "sample_top_p",
     "logits_to_probs",

@@ -12,30 +12,27 @@ valid iff `j <= q_pos`.
 
 Two implementations:
 
-- **lax fallback** (`_paged_attention_lax`): gather the table's blocks into
-  a per-sequence contiguous view and call `multihead_attention` on it.
-  Bit-for-bit the same softmax chain as the dense op — this is what the
-  tier-1 CPU parity tests pin down, and what guarantees the serving engine's
-  greedy streams match `Generator.generate`.
-- **Pallas kernels**: TPU block-table decode kernels in the spirit of
-  "Ragged Paged Attention" (PAPERS.md, arxiv 2604.15464): grid
-  `(B, max_blocks)`, the block table rides in as a scalar-prefetch operand
-  so the index map DMAs exactly the blocks each sequence owns (unneeded
-  trailing grid steps remap to block 0 and skip compute), online-softmax
-  accumulation in VMEM scratch.  `_paged_attention_kernel` is the
-  single-query (Tq == 1) decode step; `_paged_attention_ragged_kernel`
-  generalizes it to **ragged multi-query decode** — each sequence attends
-  with up to `Tq` query tokens at its own absolute positions, which is the
-  shape the serving engine's batched speculative verify dispatches (K
-  drafted tokens + 1 per slot, every slot at a different depth).  Semantics
-  are validated against the fallback in interpreter mode; the fallback
-  remains the default off-TPU.
-- **`paged_prefill`**: the unified serving step's ragged mixed
-  prefill+decode attention — every live slot's tokens (decode lanes at 1
-  token, prefill chunks at their fed width) packed slot-major into ONE
-  query axis, per-slot `q_start/q_len/q_pos` scalar-prefetched, causal
-  masking inside each slot's own chunk, one online-softmax row per
-  (head, packed token).  Plus the bit-exact per-token gather fallback.
+- **lax fallback** (`_paged_attention_lax` / `_paged_prefill_lax`): gather
+  each table's blocks into a contiguous per-slot view and run the dense
+  softmax chain on it.  Bit-for-bit the same math as the dense op — this
+  is what the tier-1 CPU parity tests pin down, and what guarantees the
+  serving engine's greedy streams match `Generator.generate`.
+- **the unified Pallas kernel** (`ops/ragged_paged_attention.py`): ONE
+  kernel for every serving shape — pure decode (Tq == 1), ragged
+  multi-query decode at ANY width (batched speculative verify, no
+  16-token cap), and packed ragged mixed prefill+decode — over one
+  scalar-prefetched span layout.  `paged_attention` packs its per-sequence
+  (B, n_head, Tq, hs) batch into the span layout (each sequence = one
+  span of width Tq); `paged_prefill` passes its packed layout through.
+  Kernel block/grid parameters (`ops/tuning.py`: kv_step, q_pack,
+  scratch_width) resolve host-side at trace time from the committed or
+  `mdi-tune`d tables, so the choice is compile-time static — zero
+  post-warmup recompiles.  Semantics are validated against the fallback
+  in interpreter mode; the fallback remains the default off-TPU.
+
+Explicit `use_kernel=True` with anything unsupported (no pallas build, an
+invalid tuning entry, a malformed pool) raises actionably — it never
+silently degrades to the fallback; `use_kernel=None` auto-routes.
 
 Writes go through `paged_update`: a scatter of the chunk's K/V into
 `(block, offset)` slots resolved through the table.  Positions past the
@@ -55,8 +52,8 @@ rescale by old/new, scatter back — a transient of written blocks only,
 never the pool).  Consequences the serving engine relies on, pinned by
 tests: a frozen-lane rewrite of the same (token, position) leaves scale
 and payload bytes bit-identical, and a block's final scale is independent
-of how its tokens were grouped into update calls.  All three kernels
-dequantize INSIDE their KV-block loop (`k = int8_block * scale[group]` in
+of how its tokens were grouped into update calls.  The unified kernel
+dequantizes INSIDE its KV-block loop (`k = int8_block * scale[group]` in
 f32, fused after the block DMA) — no gathered-fp pool transient — and the
 lax fallbacks run the same dequant-to-f32 math so kernel==fallback parity
 holds at int8 exactly like fp.
@@ -71,13 +68,18 @@ import jax
 import jax.numpy as jnp
 
 from mdi_llm_tpu.ops.attention import NEG_INF, multihead_attention
+from mdi_llm_tpu.ops.ragged_paged_attention import (
+    _HAS_PALLAS,
+    ragged_paged_attention,
+)
+from mdi_llm_tpu.ops.tuning import KernelParams, resolve_kernel_params
 
 __all__ = [
     "paged_attention",
     "paged_prefill",
     "paged_update",
     "gather_paged_kv",
-    "RAGGED_KERNEL_MAX_TQ",
+    "KernelParams",
 ]
 
 
@@ -170,8 +172,8 @@ def gather_paged_kv(
     """Materialize each sequence's contiguous (B, G, S, hs) view,
     S = max_blocks * block_size.  Flattened slot j holds absolute position
     j by the table-layout contract.  int8 pools dequantize to f32 — the
-    same `int8 * scale` math the kernels run inside their block loop, so
-    the fallback stays the kernels' parity reference at int8 too."""
+    same `int8 * scale` math the kernel runs inside its block loop, so
+    the fallback stays the kernel's parity reference at int8 too."""
     if isinstance(pool, dict):
         g = pool["q"][block_tables].astype(jnp.float32)  # (B, MB, BS, G, hs)
         s = pool["scale"][block_tables]  # (B, MB, G)
@@ -187,7 +189,7 @@ def _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale):
     v = gather_paged_kv(v_pool, block_tables)
     if isinstance(k_pool, dict):
         # dequantized KV is f32; run q in f32 too so the softmax chain is
-        # the exact math the kernels compute (multihead_attention would
+        # the exact math the kernel computes (multihead_attention would
         # otherwise downcast the f32 KV to q's dtype at the read)
         out = multihead_attention(
             q.astype(jnp.float32), k, v, q_pos, scale=scale
@@ -198,24 +200,25 @@ def _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale):
 
 
 # ---------------------------------------------------------------------------
-# Pallas kernel path (TPU): block-table decode, one query token per sequence
+# Pallas kernel path (TPU): the unified ragged kernel behind both entries
 # ---------------------------------------------------------------------------
-
-# import guarded so a stripped jax build without pallas still serves the
-# lax fallback (pallas itself imports fine on plain CPU)
-try:  # pragma: no cover - import guard
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    _HAS_PALLAS = False
 
 # Pallas calls cannot be GSPMD-partitioned, so the tensor-parallel serving
 # engine runs them per shard under jax.shard_map (the same manual-region
 # pattern as parallel/pipeline.py).  Gated like the rest of the repo's
 # shard_map users: older jax builds fall back to the lax path under a mesh.
 _HAS_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def _kernel_auto(shard_axes) -> bool:
+    """The `use_kernel=None` routing rule: the unified kernel serves every
+    shape on a pallas-enabled TPU backend (no Tq width cap — the old
+    RAGGED_KERNEL_MAX_TQ=16 cliff is gone); anything else falls back."""
+    return (
+        _HAS_PALLAS
+        and jax.default_backend() == "tpu"
+        and (shard_axes is None or _HAS_SHARD_MAP)
+    )
 
 
 def _run_sharded_kernel(kernel_fn, mesh, axis, q, k_pool, v_pool, *scalars):
@@ -248,470 +251,109 @@ def _run_sharded_kernel(kernel_fn, mesh, axis, q, k_pool, v_pool, *scalars):
     )(q, k_pool, v_pool, *scalars)
 
 
-def _decode_kernel(
-    # scalar prefetch
-    tables_ref,  # (B, MB) int32
-    lens_ref,  # (B,) int32 — valid KV length per sequence (q_pos + 1)
-    # blocks
-    q_ref,  # (1, n_head, hs)
-    k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
-    v_ref,
-    # quantized pools insert (ks_ref, vs_ref) — the block's (1, G) f32
-    # scales, riding the same table-resolved index map as k/v — before the
-    # output; fp pools go straight to o_ref
-    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
-    block_size: int,
-    n_groups: int,
-    scale: float,
-    quantized: bool = False,
-):
-    if quantized:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
-    else:
-        o_ref, m_ref, l_ref, acc_ref = rest
-    b = pl.program_id(0)
-    i = pl.program_id(1)
-
-    @pl.when(i == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    n_live = lens_ref[b]
-
-    @pl.when(i * block_size < n_live)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)  # (n_head, hs)
-        k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
-        v = v_ref[0].astype(jnp.float32)
-        if quantized:
-            # in-loop dequant: the int8 block just DMA'd scales by its own
-            # per-group factor — no fp copy of the pool ever materializes
-            k = k * ks_ref[0][None, :, None]
-            v = v * vs_ref[0][None, :, None]
-        n_head, hs = q.shape
-        q_per_kv = n_head // n_groups
-        qg = q.reshape(n_groups, q_per_kv, hs)
-        # (G, q_per_kv, BS) logits; batch dim G maps heads onto their group
-        s = jax.lax.dot_general(
-            qg,
-            k.transpose(1, 2, 0),  # (G, hs, BS)
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        jpos = i * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, block_size), 2
-        )
-        s = jnp.where(jpos < n_live, s, NEG_INF)
-        s = s.reshape(n_head, block_size)
-
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)  # (n_head, BS)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.reshape(n_groups, q_per_kv, block_size),
-            v.transpose(1, 0, 2),  # (G, BS, hs)
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ).reshape(n_head, hs)
-        acc_ref[...] = corr * acc_ref[...] + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    @pl.when(i == pl.num_programs(1) - 1)
-    def _finalize():
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
-
-
-# widest multi-query width the ragged kernel accepts: each (head, query)
-# pair is an independent online-softmax row in VMEM scratch, so scratch
-# grows linearly with Tq — speculative verify widths (K+1 <= ~9) are the
-# target; prefill chunks (Tq ~ 128) stay on the gather fallback
-RAGGED_KERNEL_MAX_TQ = 16
-
-
-def _ragged_decode_kernel(
-    # scalar prefetch
-    tables_ref,  # (B, MB) int32
-    lens_ref,  # (B,) int32 — valid KV length per sequence (max q_pos + 1)
-    qpos_ref,  # (B, Tq) int32 — absolute position of every query token
-    # blocks
-    q_ref,  # (1, n_head, Tq, hs)
-    k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
-    v_ref,
-    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref — see
-    # _decode_kernel: quantized pools insert the block's (1, G) scales
-    block_size: int,
-    n_groups: int,
-    n_queries: int,
-    scale: float,
-    quantized: bool = False,
-):
-    # o_ref (1, n_head, Tq, hs); scratch: every (head, query) pair is one
-    # independent softmax row — m/l (n_head * Tq, 128), acc (n_head*Tq, hs)
-    if quantized:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
-    else:
-        o_ref, m_ref, l_ref, acc_ref = rest
-    b = pl.program_id(0)
-    i = pl.program_id(1)
-
-    @pl.when(i == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    n_live = lens_ref[b]
-
-    @pl.when(i * block_size < n_live)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)  # (n_head, Tq, hs)
-        n_head, Tq, hs = q.shape
-        q_per_kv = n_head // n_groups
-        k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
-        v = v_ref[0].astype(jnp.float32)
-        if quantized:  # in-loop dequant, see _decode_kernel
-            k = k * ks_ref[0][None, :, None]
-            v = v * vs_ref[0][None, :, None]
-        # heads map onto their KV group; the Tq queries fold into the row
-        # dim so one dot_general scores every (head, query) pair
-        qg = q.reshape(n_groups, q_per_kv * Tq, hs)
-        s = jax.lax.dot_general(
-            qg,
-            k.transpose(1, 2, 0),  # (G, hs, BS)
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        s = s.reshape(n_head, Tq, block_size)
-        # ragged causal mask: key at absolute position j is valid for query
-        # t iff j <= q_pos[t] — the dense op's one rule, per query row
-        jpos = i * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, block_size), 2
-        )
-        # scalar-prefetch reads are scalar loads; Tq is static and small
-        qpos = jnp.stack([qpos_ref[b, t] for t in range(n_queries)])
-        s = jnp.where(jpos <= qpos[None, :, None], s, NEG_INF)
-        s = s.reshape(n_head * Tq, block_size)
-
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)  # (n_head * Tq, BS)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.reshape(n_groups, q_per_kv * Tq, block_size),
-            v.transpose(1, 0, 2),  # (G, BS, hs)
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ).reshape(n_head * Tq, hs)
-        acc_ref[...] = corr * acc_ref[...] + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    @pl.when(i == pl.num_programs(1) - 1)
-    def _finalize():
-        # fully-masked rows (a query past the slot's live length, e.g. a
-        # padded draft lane) have l == 0; the floor keeps them finite —
-        # their output is garbage by contract and discarded by the caller
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        out = acc_ref[...] / denom
-        n_head_tq, hs = out.shape
-        o_ref[0] = out.reshape(
-            n_head_tq // n_queries, n_queries, hs
-        ).astype(o_ref.dtype)
-
-
-def _paged_attention_ragged_kernel(
-    q, k_pool, v_pool, block_tables, q_pos, scale, interpret=False
-):
-    """q: (B, n_head, Tq, hs) → (B, n_head, Tq, hs), per-slot q_pos (B, Tq)."""
-    B, n_head, Tq, hs = q.shape
-    k_arr, k_sc = _pool_parts(k_pool)
-    v_arr, v_sc = _pool_parts(v_pool)
-    quantized = k_sc is not None
-    NB, BS, G, _ = k_arr.shape
-    MB = block_tables.shape[1]
-    lens = (jnp.max(q_pos, axis=1) + 1).astype(jnp.int32)
-    tables = block_tables.astype(jnp.int32)
-
-    def kv_index(bidx, i, tables_ref, lens_ref, qpos_ref):
-        # see _paged_attention_kernel: trailing grid steps remap to block 0
-        needed = i * BS < lens_ref[bidx]
-        return (jnp.where(needed, tables_ref[bidx, i], 0), 0, 0, 0)
-
-    def scale_index(bidx, i, tables_ref, lens_ref, qpos_ref):
-        needed = i * BS < lens_ref[bidx]
-        return (jnp.where(needed, tables_ref[bidx, i], 0), 0)
-
-    in_specs = [
-        pl.BlockSpec((1, n_head, Tq, hs), lambda b, i, *_: (b, 0, 0, 0)),
-        pl.BlockSpec((1, BS, G, hs), kv_index),
-        pl.BlockSpec((1, BS, G, hs), kv_index),
-    ]
-    operands = [q, k_arr, v_arr]
-    if quantized:
-        in_specs += [pl.BlockSpec((1, G), scale_index)] * 2
-        operands += [k_sc, v_sc]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B, MB),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, n_head, Tq, hs), lambda b, i, *_: (b, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((n_head * Tq, 128), jnp.float32),
-            pltpu.VMEM((n_head * Tq, 128), jnp.float32),
-            pltpu.VMEM((n_head * Tq, hs), jnp.float32),
-        ],
+def _shard_unified_body(q, k_pool, v_pool, tables, q_start, q_len, lens,
+                        q_pos, *, scale, params, interpret):
+    # inside shard_map: local head/KV-group slices, replicated metadata.
+    # params resolved OUTSIDE on the global geometry; the builder folds
+    # q_pack down to the local group count (gcd), deterministically.
+    return ragged_paged_attention(
+        q, k_pool, v_pool, tables, q_start, q_len, lens, q_pos,
+        scale=scale, params=params, interpret=interpret,
     )
-    kern = functools.partial(
-        _ragged_decode_kernel,
-        block_size=BS, n_groups=G, n_queries=Tq, scale=scale,
-        quantized=quantized,
+
+
+def _dispatch_unified(q, k_pool, v_pool, block_tables, q_start, q_len, lens,
+                      q_pos, scale, params, interpret, shard_axes, who):
+    """Shared kernel-path dispatch for both public entries: resolve the
+    tuning-table entry (host-side, trace-time — compile-time static), then
+    run the unified kernel directly or per tp shard under shard_map."""
+    n_head, hs = q.shape[1], q.shape[-1]
+    k_arr = _pool_parts(k_pool)[0]
+    BS, G = k_arr.shape[1], k_arr.shape[2]
+    if params is None:
+        device_kind = None
+        if jax.default_backend() == "tpu":
+            device_kind = jax.devices()[0].device_kind
+        params, _ = resolve_kernel_params(
+            n_head=n_head, n_groups=G, head_size=hs, block_size=BS,
+            kv_dtype="int8" if isinstance(k_pool, dict) else None,
+            device_kind=device_kind,
+        )
+    if shard_axes is not None:
+        if not _HAS_SHARD_MAP:
+            raise ValueError(
+                f"{who} kernel under a mesh needs jax.shard_map (missing "
+                "in this jax build); use the lax fallback (use_kernel="
+                "False)"
+            )
+        mesh, axis = shard_axes
+        kern = functools.partial(
+            _shard_unified_body, scale=scale, params=params,
+            interpret=interpret,
+        )
+        return _run_sharded_kernel(
+            kern, mesh, axis, q, k_pool, v_pool,
+            block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
+            q_len.astype(jnp.int32), lens.astype(jnp.int32),
+            q_pos.astype(jnp.int32),
+        )
+    return ragged_paged_attention(
+        q, k_pool, v_pool, block_tables, q_start, q_len, lens, q_pos,
+        scale=scale, params=params, interpret=interpret,
     )
-    out = pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, n_head, Tq, hs), q.dtype),
-        interpret=interpret,
-    )(tables, lens, q_pos.astype(jnp.int32), *operands)
-    return out
 
 
-def _ragged_prefill_kernel(
-    # scalar prefetch (per SLOT, not per token — the whole point of the
-    # packed layout is that slot metadata is O(slots), not O(tokens))
-    tables_ref,  # (S, MB) int32
-    qstart_ref,  # (S,) int32 — offset of slot s's query span in the packed axis
-    qlen_ref,  # (S,) int32 — span length (0 = slot absent this step)
-    qpos0_ref,  # (S,) int32 — absolute position of the span's FIRST token
-    # blocks
-    q_ref,  # (1, n_head, T, hs) — the whole packed batch rides every step
-    k_ref,  # (1, BS, G, hs) — the table-resolved block for this grid step
-    v_ref,
-    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref — see
-    # _decode_kernel: quantized pools insert the block's (1, G) scales
-    block_size: int,
-    n_groups: int,
-    n_tokens: int,
-    scale: float,
-    quantized: bool = False,
-):
-    # o_ref (1, n_head, T, hs); scratch: every (head, packed token) pair
-    # is one online-softmax row — m/l (n_head * T, 128), acc (n_head*T, hs)
-    if quantized:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
-    else:
-        o_ref, m_ref, l_ref, acc_ref = rest
-    # Known tradeoff: every grid step scores the WHOLE packed q against the
-    # step's kv block and masks rows outside the current slot's span, so
-    # ~(1 - 1/n_live_slots) of each matmul is discarded.  The static shapes
-    # keep the kernel one compile and the scratch layout trivial; if this
-    # waste ever shows up on profiles, the fix is a q-tile grid axis with a
-    # host-computed tile->slot map in scalar prefetch so each step's matmul
-    # covers only one slot's span.
-    s_id = pl.program_id(0)
-    i = pl.program_id(1)
-
-    @pl.when(jnp.logical_and(s_id == 0, i == 0))
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    q_start = qstart_ref[s_id]
-    q_len = qlen_ref[s_id]
-    q_pos0 = qpos0_ref[s_id]
-    n_live = q_pos0 + q_len  # KV slots visible to the span's deepest query
-
-    @pl.when(jnp.logical_and(q_len > 0, i * block_size < n_live))
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)  # (n_head, T, hs)
-        n_head, T, hs = q.shape
-        q_per_kv = n_head // n_groups
-        k = k_ref[0].astype(jnp.float32)  # (BS, G, hs)
-        v = v_ref[0].astype(jnp.float32)
-        if quantized:  # in-loop dequant, see _decode_kernel
-            k = k * ks_ref[0][None, :, None]
-            v = v * vs_ref[0][None, :, None]
-        qg = q.reshape(n_groups, q_per_kv * T, hs)
-        s = jax.lax.dot_general(
-            qg,
-            k.transpose(1, 2, 0),  # (G, hs, BS)
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        s = s.reshape(n_head, T, block_size)
-        # the slot owns packed rows [q_start, q_start + q_len); its spans are
-        # contiguous position runs, so token t's absolute position is
-        # q_pos0 + (t - q_start) — causal masking inside the slot's own
-        # chunk falls out of the one rule: key at j valid iff j <= q_pos[t]
-        t_idx = jax.lax.broadcasted_iota(jnp.int32, (1, T, 1), 1)
-        in_span = jnp.logical_and(t_idx >= q_start, t_idx < q_start + q_len)
-        qpos = q_pos0 + (t_idx - q_start)
-        jpos = i * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, block_size), 2
-        )
-        s = jnp.where(jnp.logical_and(in_span, jpos <= qpos), s, NEG_INF)
-        s = s.reshape(n_head * T, block_size)
-
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)  # (n_head * T, BS)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.reshape(n_groups, q_per_kv * T, block_size),
-            v.transpose(1, 0, 2),  # (G, BS, hs)
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ).reshape(n_head * T, hs)
-        # rows OUTSIDE this slot's span must keep their state untouched:
-        # NEG_INF is finite, so a fully-masked untouched row would compute
-        # p = exp(NEG_INF - NEG_INF) = 1 and pollute another slot's
-        # accumulator with this slot's V blocks — gate the update per row
-        row = jnp.broadcast_to(
-            in_span.reshape(1, T), (n_head, T)
-        ).reshape(n_head * T, 1)
-        m_ref[...] = jnp.where(
-            row, jnp.broadcast_to(m_new, m_ref.shape), m_ref[...]
-        )
-        l_ref[...] = jnp.where(
-            row, jnp.broadcast_to(l_new, l_ref.shape), l_ref[...]
-        )
-        acc_ref[...] = jnp.where(row, corr * acc_ref[...] + pv, acc_ref[...])
-
-    @pl.when(jnp.logical_and(
-        s_id == pl.num_programs(0) - 1, i == pl.num_programs(1) - 1
-    ))
-    def _finalize():
-        # padding rows no slot owns never accumulate (l == 0): the floor
-        # keeps them finite — garbage by contract, discarded by the caller
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        out = acc_ref[...] / denom
-        n_head_t, hs = out.shape
-        o_ref[0] = out.reshape(
-            n_head_t // n_tokens, n_tokens, hs
-        ).astype(o_ref.dtype)
-
-
-def _paged_prefill_kernel(
-    q, k_pool, v_pool, block_tables, q_start, q_len, q_pos, scale,
-    interpret=False,
-):
-    """q: (1, n_head, T, hs) packed slot-major → (1, n_head, T, hs)."""
-    B, n_head, T, hs = q.shape
-    assert B == 1, "paged_prefill packs every slot into one ragged batch"
-    k_arr, k_sc = _pool_parts(k_pool)
-    v_arr, v_sc = _pool_parts(v_pool)
-    quantized = k_sc is not None
-    NB, BS, G, _ = k_arr.shape
-    S, MB = block_tables.shape
-    tables = block_tables.astype(jnp.int32)
-    qstart = q_start.astype(jnp.int32)
-    qlen = q_len.astype(jnp.int32)
-    # the span's first absolute position (spans are contiguous runs); the
-    # clip only guards absent slots, whose q_len == 0 skips all compute
-    qpos0 = q_pos.astype(jnp.int32)[jnp.clip(qstart, 0, T - 1)]
-
-    def kv_index(sidx, i, tables_ref, qstart_ref, qlen_ref, qpos0_ref):
-        # see _paged_attention_kernel: unneeded grid steps remap to block 0
-        needed = jnp.logical_and(
-            qlen_ref[sidx] > 0,
-            i * BS < qpos0_ref[sidx] + qlen_ref[sidx],
-        )
-        return (jnp.where(needed, tables_ref[sidx, i], 0), 0, 0, 0)
-
-    def scale_index(sidx, i, tables_ref, qstart_ref, qlen_ref, qpos0_ref):
-        needed = jnp.logical_and(
-            qlen_ref[sidx] > 0,
-            i * BS < qpos0_ref[sidx] + qlen_ref[sidx],
-        )
-        return (jnp.where(needed, tables_ref[sidx, i], 0), 0)
-
-    in_specs = [
-        pl.BlockSpec((1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)),
-        pl.BlockSpec((1, BS, G, hs), kv_index),
-        pl.BlockSpec((1, BS, G, hs), kv_index),
-    ]
-    operands = [q, k_arr, v_arr]
-    if quantized:
-        in_specs += [pl.BlockSpec((1, G), scale_index)] * 2
-        operands += [k_sc, v_sc]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
-        grid=(S, MB),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((n_head * T, 128), jnp.float32),
-            pltpu.VMEM((n_head * T, 128), jnp.float32),
-            pltpu.VMEM((n_head * T, hs), jnp.float32),
-        ],
-    )
-    kern = functools.partial(
-        _ragged_prefill_kernel,
-        block_size=BS, n_groups=G, n_tokens=T, scale=scale,
-        quantized=quantized,
-    )
-    return pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((1, n_head, T, hs), q.dtype),
-        interpret=interpret,
-    )(tables, qstart, qlen, qpos0, *operands)
-
-
-# packed tokens per gather in the lax fallback: each lane materializes its
-# slot's full-window KV view, so an unchunked (T, window) gather would be
-# token_budget-fold the old B=1 prefill fallback's footprint (~hundreds of
-# MB per layer per step at TinyLlama scale); lax.map over fixed chunks
-# keeps the transient ∝ chunk while staying exact per row
+# packed tokens per chunk in the lax fallback: each lane reads its slot's
+# full-window KV view, so an unchunked (T, window) score matrix would be
+# token_budget-fold the old B=1 prefill fallback's footprint; lax.map over
+# fixed chunks keeps the attention transient ∝ chunk while staying exact
+# per row
 _LAX_FALLBACK_CHUNK = 16
 
 
 def _paged_prefill_lax(q, k_pool, v_pool, block_tables, q_slot, q_pos, scale):
     """Exact fallback: each packed token is one lane of the decode fallback
-    with its OWN slot's table — per-token gather, the dense softmax chain
-    bit-for-bit (the serving engine's greedy parity contract).  Wide packed
-    batches run the same math in fixed-size chunks of the token axis
-    (sequential lax.map) to bound the gathered-KV transient."""
+    reading its OWN slot's contiguous view — the dense softmax chain
+    bit-for-bit (the serving engine's greedy parity contract).
+
+    The pool is gathered ONCE per call into per-slot dense views (one take
+    over the slot axis), and the chunked `lax.map` body only INDEXES those
+    views per lane — the old shape gathered the pool through
+    `block_tables[sc]` inside every chunk, paying O(T) tiny per-token
+    gathers that dominated CPU CI and kernel-less TPU builds.  Same
+    elements either way (`pool[tables][sc] == pool[tables[sc]]`
+    row-for-row), so the outputs are bit-identical to the old fallback;
+    wide packed batches still run fixed-size chunks of the token axis
+    (sequential lax.map) to bound the attention transient."""
+    quantized = isinstance(k_pool, dict)
+    k = gather_paged_kv(k_pool, block_tables)  # (S, G, W, hs)
+    v = gather_paged_kv(v_pool, block_tables)
     qt = q[0].transpose(1, 0, 2)[:, :, None, :]  # (T, n_head, 1, hs)
+    if quantized:
+        # dequantized KV is f32; run q in f32 too (see _paged_attention_lax)
+        qt = qt.astype(jnp.float32)
     T = qt.shape[0]
     C = _LAX_FALLBACK_CHUNK
+
+    def run(qc, sc, pc):
+        return multihead_attention(qc, k[sc], v[sc], pc[:, None], scale=scale)
+
     if T <= C:
-        out = _paged_attention_lax(
-            qt, k_pool, v_pool, block_tables[q_slot], q_pos[:, None], scale
-        )
-        return out[:, :, 0, :].transpose(1, 0, 2)[None]
-    pad = -T % C
-    # pad rows carry slot 0 / position 0: garbage by contract, sliced off
-    qt_p = jnp.pad(qt, ((0, pad), (0, 0), (0, 0), (0, 0)))
-    slot_p = jnp.pad(q_slot, (0, pad))
-    pos_p = jnp.pad(q_pos, (0, pad))
-
-    def chunk(args):
-        qc, sc, pc = args
-        return _paged_attention_lax(
-            qc, k_pool, v_pool, block_tables[sc], pc[:, None], scale
-        )
-
-    out = jax.lax.map(chunk, (
-        qt_p.reshape(-1, C, *qt.shape[1:]),
-        slot_p.reshape(-1, C),
-        pos_p.reshape(-1, C),
-    ))
-    out = out.reshape(-1, *out.shape[2:])[:T]
-    return out[:, :, 0, :].transpose(1, 0, 2)[None]
+        out = run(qt, q_slot, q_pos)
+    else:
+        pad = -T % C
+        # pad rows carry slot 0 / position 0: garbage by contract, sliced
+        qt_p = jnp.pad(qt, ((0, pad), (0, 0), (0, 0), (0, 0)))
+        slot_p = jnp.pad(q_slot, (0, pad))
+        pos_p = jnp.pad(q_pos, (0, pad))
+        out = jax.lax.map(lambda a: run(*a), (
+            qt_p.reshape(-1, C, *qt.shape[1:]),
+            slot_p.reshape(-1, C),
+            pos_p.reshape(-1, C),
+        ))
+        out = out.reshape(-1, *out.shape[2:])[:T]
+    out = out[:, :, 0, :].transpose(1, 0, 2)[None]
+    return out.astype(q.dtype) if quantized else out
 
 
 def paged_prefill(
@@ -728,6 +370,8 @@ def paged_prefill(
     interpret: bool = False,
     shard_axes: Optional[Tuple] = None,  # (Mesh, tp_axis): run the kernel
     # per tensor-parallel shard (heads/KV groups split, tables replicated)
+    params: Optional[KernelParams] = None,  # kernel tuning override; None
+    # resolves the mdi-tune/builtin tables at trace time (ops/tuning.py)
 ) -> jnp.ndarray:
     """Ragged mixed prefill+decode attention over the paged pool.
 
@@ -737,8 +381,9 @@ def paged_prefill(
     axis; each packed token attends through its own slot's block table at
     its own absolute position.  Slot spans are contiguous position runs, so
     per-slot (q_start, q_len, first position) fully describe the raggedness
-    — the kernel scalar-prefetches exactly that.  Packed positions no slot
-    owns (batch-tail padding) return garbage rows the caller discards.
+    — this is the unified kernel's native layout and passes straight
+    through.  Packed positions no slot owns (batch-tail padding) return
+    garbage rows the caller discards.
 
     With `shard_axes` (the tensor-parallel serving engine), the kernel path
     runs inside `jax.shard_map` over the tp axis: each device scores its
@@ -748,103 +393,31 @@ def paged_prefill(
     Returns (1, n_head, T, hs).
     """
     hs = q.shape[-1]
+    T = q.shape[2]
     if scale is None:
         scale = 1.0 / (hs**0.5)
     if use_kernel is None:
-        use_kernel = (
-            _HAS_PALLAS
-            and jax.default_backend() == "tpu"
-            and (shard_axes is None or _HAS_SHARD_MAP)
+        use_kernel = _kernel_auto(shard_axes)
+    elif use_kernel and not _HAS_PALLAS:
+        raise ValueError(
+            "paged_prefill: use_kernel=True but this jax build has no "
+            "jax.experimental.pallas — drop use_kernel (lax fallback) or "
+            "install a pallas-enabled jax"
         )
-    if use_kernel and _HAS_PALLAS:
-        if shard_axes is not None:
-            if not _HAS_SHARD_MAP:
-                raise ValueError(
-                    "paged_prefill kernel under a mesh needs jax.shard_map "
-                    "(missing in this jax build); use the lax fallback "
-                    "(use_kernel=False)"
-                )
-            mesh, axis = shard_axes
-            kern = functools.partial(
-                _shard_prefill_body, scale=scale, interpret=interpret
-            )
-            return _run_sharded_kernel(
-                kern, mesh, axis, q, k_pool, v_pool,
-                block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
-                q_len.astype(jnp.int32), q_pos.astype(jnp.int32),
-            )
-        return _paged_prefill_kernel(
-            q, k_pool, v_pool, block_tables, q_start, q_len, q_pos, scale,
-            interpret=interpret,
+    if use_kernel:
+        qstart = q_start.astype(jnp.int32)
+        qlen = q_len.astype(jnp.int32)
+        # the span's deepest visible KV position + 1 (spans are contiguous
+        # runs from the first token's position); the clip only guards
+        # absent slots, whose q_len == 0 skips all compute anyway
+        lens = q_pos.astype(jnp.int32)[jnp.clip(qstart, 0, T - 1)] + qlen
+        return _dispatch_unified(
+            q, k_pool, v_pool, block_tables, qstart, qlen, lens, q_pos,
+            scale, params, interpret, shard_axes, "paged_prefill",
         )
     return _paged_prefill_lax(
         q, k_pool, v_pool, block_tables, q_slot, q_pos, scale
     )
-
-
-def _shard_prefill_body(q, k_pool, v_pool, tables, q_start, q_len, q_pos,
-                        *, scale, interpret):
-    return _paged_prefill_kernel(
-        q, k_pool, v_pool, tables, q_start, q_len, q_pos, scale,
-        interpret=interpret,
-    )
-
-
-def _paged_attention_kernel(
-    q, k_pool, v_pool, block_tables, q_pos, scale, interpret=False
-):
-    """q: (B, n_head, 1, hs) → (B, n_head, 1, hs)."""
-    B, n_head, Tq, hs = q.shape
-    assert Tq == 1, "kernel path is decode-only (Tq == 1)"
-    k_arr, k_sc = _pool_parts(k_pool)
-    v_arr, v_sc = _pool_parts(v_pool)
-    quantized = k_sc is not None
-    NB, BS, G, _ = k_arr.shape
-    MB = block_tables.shape[1]
-    lens = (q_pos[:, 0] + 1).astype(jnp.int32)
-    tables = block_tables.astype(jnp.int32)
-
-    def kv_index(bidx, i, tables_ref, lens_ref):
-        # unneeded trailing blocks remap to block 0: the DMA still happens
-        # (the grid is static) but never re-reads a far block
-        needed = i * BS < lens_ref[bidx]
-        return (jnp.where(needed, tables_ref[bidx, i], 0), 0, 0, 0)
-
-    def scale_index(bidx, i, tables_ref, lens_ref):
-        needed = i * BS < lens_ref[bidx]
-        return (jnp.where(needed, tables_ref[bidx, i], 0), 0)
-
-    in_specs = [
-        pl.BlockSpec((1, n_head, hs), lambda b, i, *_: (b, 0, 0)),
-        pl.BlockSpec((1, BS, G, hs), kv_index),
-        pl.BlockSpec((1, BS, G, hs), kv_index),
-    ]
-    operands = [q[:, :, 0, :], k_arr, v_arr]
-    if quantized:
-        in_specs += [pl.BlockSpec((1, G), scale_index)] * 2
-        operands += [k_sc, v_sc]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, MB),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, n_head, hs), lambda b, i, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((n_head, 128), jnp.float32),
-            pltpu.VMEM((n_head, 128), jnp.float32),
-            pltpu.VMEM((n_head, hs), jnp.float32),
-        ],
-    )
-    kern = functools.partial(
-        _decode_kernel, block_size=BS, n_groups=G, scale=scale,
-        quantized=quantized,
-    )
-    out = pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, n_head, hs), q.dtype),
-        interpret=interpret,
-    )(tables, lens, *operands)
-    return out[:, :, None, :]
 
 
 def paged_attention(
@@ -854,59 +427,47 @@ def paged_attention(
     block_tables: jnp.ndarray,  # (B, max_blocks) int32
     q_pos: jnp.ndarray,  # (B, Tq) absolute query positions
     scale: Optional[float] = None,
-    use_kernel: Optional[bool] = None,  # None → auto (TPU backend, decode)
+    use_kernel: Optional[bool] = None,  # None → auto (TPU backend)
     interpret: bool = False,
     shard_axes: Optional[Tuple] = None,  # (Mesh, tp_axis): run the kernel
     # per tensor-parallel shard (heads/KV groups split, tables replicated)
+    params: Optional[KernelParams] = None,  # kernel tuning override; None
+    # resolves the mdi-tune/builtin tables at trace time (ops/tuning.py)
 ) -> jnp.ndarray:
     """Causal GQA/MQA attention through per-sequence block tables.
 
-    Returns (B, n_head, Tq, hs).  Tq == 1 (the hot decode step) runs the
-    single-query kernel; 1 < Tq <= RAGGED_KERNEL_MAX_TQ (ragged speculative
-    verify: each slot scores K+1 tokens at its own depth) runs the ragged
-    multi-query kernel; wider Tq (chunked prefill attending through the
-    pool) always takes the gather fallback.  With `shard_axes`, the kernel
-    paths run inside `jax.shard_map` over the tp axis (see `paged_prefill`).
+    Returns (B, n_head, Tq, hs).  The kernel path packs the batch into the
+    unified kernel's span layout — sequence b becomes the span
+    `[b*Tq, (b+1)*Tq)` of a (1, n_head, B*Tq, hs) ragged batch with its
+    own per-token positions — so ONE kernel serves the hot decode step
+    (Tq == 1), ragged speculative verify at ANY width (each slot scores
+    K+1 tokens at its own depth; the old 16-token cap is gone), and
+    chunked prefill attending through the pool.  With `shard_axes`, the
+    kernel runs inside `jax.shard_map` over the tp axis (see
+    `paged_prefill`).
     """
-    hs = q.shape[-1]
-    Tq = q.shape[2]
+    B, n_head, Tq, hs = q.shape
     if scale is None:
         scale = 1.0 / (hs**0.5)
     if use_kernel is None:
-        use_kernel = (
-            _HAS_PALLAS
-            and jax.default_backend() == "tpu"
-            and Tq <= RAGGED_KERNEL_MAX_TQ
-            and (shard_axes is None or _HAS_SHARD_MAP)
+        use_kernel = _kernel_auto(shard_axes)
+    elif use_kernel and not _HAS_PALLAS:
+        raise ValueError(
+            "paged_attention: use_kernel=True but this jax build has no "
+            "jax.experimental.pallas — drop use_kernel (lax fallback) or "
+            "install a pallas-enabled jax"
         )
-    if use_kernel and _HAS_PALLAS and Tq <= RAGGED_KERNEL_MAX_TQ:
-        body = (
-            _paged_attention_kernel if Tq == 1
-            else _paged_attention_ragged_kernel
+    if use_kernel:
+        # pack (B, n_head, Tq, hs) slot-major: sequence b owns packed
+        # tokens [b*Tq, (b+1)*Tq) at its own absolute positions
+        qp = q.transpose(1, 0, 2, 3).reshape(1, n_head, B * Tq, hs)
+        qstart = jnp.arange(B, dtype=jnp.int32) * Tq
+        qlen = jnp.full((B,), Tq, dtype=jnp.int32)
+        lens = (jnp.max(q_pos, axis=1) + 1).astype(jnp.int32)
+        out = _dispatch_unified(
+            qp, k_pool, v_pool, block_tables, qstart, qlen, lens,
+            q_pos.reshape(-1), scale, params, interpret, shard_axes,
+            "paged_attention",
         )
-        if shard_axes is not None:
-            if not _HAS_SHARD_MAP:
-                raise ValueError(
-                    "paged_attention kernel under a mesh needs "
-                    "jax.shard_map (missing in this jax build); use the "
-                    "lax fallback (use_kernel=False)"
-                )
-            mesh, axis = shard_axes
-            kern = functools.partial(
-                _shard_attention_body, body=body, scale=scale,
-                interpret=interpret,
-            )
-            return _run_sharded_kernel(
-                kern, mesh, axis, q, k_pool, v_pool,
-                block_tables.astype(jnp.int32), q_pos.astype(jnp.int32),
-            )
-        return body(
-            q, k_pool, v_pool, block_tables, q_pos, scale,
-            interpret=interpret,
-        )
+        return out[0].reshape(n_head, B, Tq, hs).transpose(1, 0, 2, 3)
     return _paged_attention_lax(q, k_pool, v_pool, block_tables, q_pos, scale)
-
-
-def _shard_attention_body(q, k_pool, v_pool, tables, q_pos, *, body, scale,
-                          interpret):
-    return body(q, k_pool, v_pool, tables, q_pos, scale, interpret=interpret)

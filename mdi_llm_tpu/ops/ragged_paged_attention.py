@@ -1,0 +1,365 @@
+"""ONE ragged paged-attention Pallas kernel for every serving shape.
+
+Historically `ops/paged_attention.py` carried three correctness-first
+kernels — single-query decode, ragged multi-query decode (capped at
+``RAGGED_KERNEL_MAX_TQ=16``), and packed ragged prefill — with hardcoded
+grids.  This module replaces all three with a single kernel over the one
+layout they all reduce to, the paper's "Ragged Paged Attention" shape
+(PAPERS.md, arxiv 2604.15464):
+
+- every query token of every slot packs slot-major into ONE ``(1, n_head,
+  T, hs)`` axis; per-slot ``(q_start, q_len)`` spans plus a per-token
+  absolute-position vector fully describe the raggedness.  Pure decode is
+  spans of width 1, speculative verify is spans of any width (no 16-token
+  cap), chunked prefill is wide spans — same kernel, same grid.
+- grid ``(n_slots, max_blocks * steps_per_block)``: the slot's block
+  table rides in scalar prefetch so the index map DMAs exactly the KV
+  (sub-)blocks the slot owns (unneeded steps remap to trash block 0 and
+  skip compute); ``kv_step`` tokens stream per iteration with online-
+  softmax accumulation in VMEM scratch, one row per (head, packed token).
+- int8 pools dequantize INSIDE the loop (``int8_block * scale[group]``
+  fused after the block DMA) from the per-block scale refs riding the
+  same table-resolved index map — no gathered-fp transient, ever.
+- ``q_pack`` folds p KV groups into one block-diagonal matmul so (head,
+  query) rows fill full 8x128 sublanes when ``n_head*hs`` underfills a
+  lane tile (pythia-14m / tiny-llama class).  Packing is exact: the
+  off-diagonal q blocks are zeros (0*k contributes nothing to the QK
+  scores) and the PV product keeps only the diagonal blocks, so packed
+  and unpacked paths compute the same chain.
+
+The three knobs (``kv_step``, ``q_pack``, ``scratch_width``) come from
+`ops/tuning.py` — resolved host-side at trace time, so the choice is
+compile-time static and costs zero post-warmup recompiles.  Dispatch
+(packing `paged_attention`'s per-sequence batch into the span layout,
+auto/fallback routing, the shard_map tp wrapper) stays in
+`ops/paged_attention.py`; this module is the kernel and its builder.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from mdi_llm_tpu.ops.attention import NEG_INF
+from mdi_llm_tpu.ops.tuning import KernelParams, validate_kernel_params
+
+__all__ = ["ragged_paged_attention"]
+
+# import guarded so a stripped jax build without pallas still serves the
+# lax fallback (pallas itself imports fine on plain CPU)
+try:  # pragma: no cover - import guard
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _pool_parts(pool):
+    """(payload, scale-or-None): fp pools are bare arrays, int8 pools are
+    ``{"q": int8 blocks, "scale": f32 (num_blocks, G)}``."""
+    if isinstance(pool, dict):
+        return pool["q"], pool["scale"]
+    return pool, None
+
+
+def _packed_qk(qg, k, p, scale):
+    """Block-diagonal QK scores with p KV groups per matmul.
+
+    qg ``(G, rows_g, hs)``, k ``(kv, G, hs)`` -> ``(G, rows_g, kv)``, the
+    exact same scores as the unpacked per-group dot: group g = gp*p + j
+    lands in row-block j / col-block j of the (p*rows_g, p*hs) operands,
+    and the zero off-diagonal q blocks add exact zeros to each dot.
+    """
+    G, rows_g, hs = qg.shape
+    kv = k.shape[0]
+    gp = G // p
+    eye = jnp.eye(p, dtype=jnp.float32)
+    qbd = (
+        qg.reshape(gp, p, rows_g, 1, hs) * eye.reshape(1, p, 1, p, 1)
+    ).reshape(gp, p * rows_g, p * hs)
+    kp = k.transpose(1, 2, 0).reshape(gp, p * hs, kv)
+    s = jax.lax.dot_general(
+        qbd, kp, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    return s.reshape(G, rows_g, kv)
+
+
+def _packed_pv(pm, v, p):
+    """Block-diagonal PV with p KV groups per matmul.
+
+    pm ``(G, rows_g, kv)`` softmax weights, v ``(kv, G, hs)`` ->
+    ``(G, rows_g, hs)``: the packed product computes a (p x p)-block
+    result per group pack and keeps only the diagonal blocks — row-block
+    j x col-block j is exactly group j's P·V.
+    """
+    G, rows_g, kv = pm.shape
+    hs = v.shape[-1]
+    gp = G // p
+    eye = jnp.eye(p, dtype=jnp.float32)
+    pb = pm.reshape(gp, p * rows_g, kv)
+    vp = (
+        v.transpose(1, 0, 2)
+        .reshape(gp, p, kv, hs)
+        .transpose(0, 2, 1, 3)
+        .reshape(gp, kv, p * hs)
+    )
+    pv = jax.lax.dot_general(
+        pb, vp, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    pv = (
+        pv.reshape(gp, p, rows_g, p, hs) * eye.reshape(1, p, 1, p, 1)
+    ).sum(axis=3)
+    return pv.reshape(G, rows_g, hs)
+
+
+def _unified_kernel(
+    # scalar prefetch (per SLOT — metadata is O(slots), not O(tokens))
+    tables_ref,  # (S, MB) int32
+    qstart_ref,  # (S,) int32 — offset of slot s's span in the packed axis
+    qlen_ref,  # (S,) int32 — span length (0 = slot absent this step)
+    lens_ref,  # (S,) int32 — valid KV length (deepest visible pos + 1)
+    # tensor blocks
+    qpos_ref,  # (1, T) int32 — absolute position of EVERY packed token
+    # (a VMEM vector read; scalar-prefetch refs only serve scalar loads)
+    q_ref,  # (1, n_head, T, hs) — the whole packed batch rides every step
+    k_ref,  # (1, kv_step, G, hs) — table-resolved KV sub-block
+    v_ref,
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref — quantized
+    # pools insert the sub-block's (1, G) scales before the output
+    kv_step: int,
+    n_groups: int,
+    n_tokens: int,
+    scale: float,
+    q_pack: int,
+    quantized: bool,
+):
+    # o_ref (1, n_head, T, hs); scratch: every (head, packed token) pair
+    # is one online-softmax row — m/l (n_head*T, scratch_width),
+    # acc (n_head*T, hs)
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    s_id = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(s_id == 0, i == 0))
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = qstart_ref[s_id]
+    q_len = qlen_ref[s_id]
+    n_live = lens_ref[s_id]
+
+    @pl.when(jnp.logical_and(q_len > 0, i * kv_step < n_live))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (n_head, T, hs)
+        n_head, T, hs = q.shape
+        q_per_kv = n_head // n_groups
+        k = k_ref[0].astype(jnp.float32)  # (kv_step, G, hs)
+        v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # in-loop dequant: the int8 sub-block just DMA'd scales by its
+            # own per-group factor — no fp copy of the pool materializes
+            k = k * ks_ref[0][None, :, None]
+            v = v * vs_ref[0][None, :, None]
+        rows_g = q_per_kv * T
+        qg = q.reshape(n_groups, rows_g, hs)
+        if q_pack > 1:
+            s = _packed_qk(qg, k, q_pack, scale)
+        else:
+            s = jax.lax.dot_general(
+                qg,
+                k.transpose(1, 2, 0),  # (G, hs, kv_step)
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+        s = s.reshape(n_head, T, kv_step)
+        # ragged causal mask, the dense op's ONE rule per packed row: key
+        # at absolute position j is valid for token t iff j <= q_pos[t]
+        # and t lies in this slot's span
+        t_idx = jax.lax.broadcasted_iota(jnp.int32, (1, T, 1), 1)
+        in_span = jnp.logical_and(t_idx >= q_start, t_idx < q_start + q_len)
+        qpos = qpos_ref[0].reshape(1, T, 1)
+        jpos = i * kv_step + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, kv_step), 2
+        )
+        s = jnp.where(jnp.logical_and(in_span, jpos <= qpos), s, NEG_INF)
+        s = s.reshape(n_head * T, kv_step)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # (n_head * T, kv_step)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pg = p.reshape(n_groups, rows_g, kv_step)
+        if q_pack > 1:
+            pv = _packed_pv(pg, v, q_pack).reshape(n_head * T, hs)
+        else:
+            pv = jax.lax.dot_general(
+                pg,
+                v.transpose(1, 0, 2),  # (G, kv_step, hs)
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ).reshape(n_head * T, hs)
+        # rows OUTSIDE this slot's span must keep their state untouched:
+        # NEG_INF is finite, so a fully-masked untouched row would compute
+        # p = exp(NEG_INF - NEG_INF) = 1 and pollute another slot's
+        # accumulator with this slot's V blocks — gate the update per row
+        row = jnp.broadcast_to(
+            in_span.reshape(1, T), (n_head, T)
+        ).reshape(n_head * T, 1)
+        m_ref[...] = jnp.where(
+            row, jnp.broadcast_to(m_new, m_ref.shape), m_ref[...]
+        )
+        l_ref[...] = jnp.where(
+            row, jnp.broadcast_to(l_new, l_ref.shape), l_ref[...]
+        )
+        acc_ref[...] = jnp.where(row, corr * acc_ref[...] + pv, acc_ref[...])
+
+    @pl.when(jnp.logical_and(
+        s_id == pl.num_programs(0) - 1, i == pl.num_programs(1) - 1
+    ))
+    def _finalize():
+        # padding rows no slot owns never accumulate (l == 0): the floor
+        # keeps them finite — garbage by contract, discarded by the caller
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        out = acc_ref[...] / denom
+        n_head_t, hs = out.shape
+        o_ref[0] = out.reshape(
+            n_head_t // n_tokens, n_tokens, hs
+        ).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # (1, n_head, T, hs) packed slot-major ragged queries
+    k_pool,  # (num_blocks, block_size, G, hs), or int8 {"q", "scale"}
+    v_pool,
+    block_tables: jnp.ndarray,  # (n_slots, max_blocks) int32
+    q_start: jnp.ndarray,  # (n_slots,) span offset per slot
+    q_len: jnp.ndarray,  # (n_slots,) span length (0 = slot absent)
+    lens: jnp.ndarray,  # (n_slots,) valid KV length (deepest pos + 1)
+    q_pos: jnp.ndarray,  # (T,) absolute position per packed token
+    *,
+    scale: float,
+    params: Optional[KernelParams] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Build and run the unified kernel on one packed ragged batch.
+
+    This is the raw kernel entry — dispatch, fallbacks and the tp
+    shard_map wrapper live in `ops/paged_attention.py`.  `params=None`
+    resolves the conservative defaults for the pool geometry; pass the
+    tuned entry from `ops/tuning.resolve_kernel_params` to pick layout.
+    Raises `ValueError` (actionably) on unsupported shapes or invalid
+    tuning parameters instead of silently degrading.  Returns
+    ``(1, n_head, T, hs)``.
+    """
+    if not _HAS_PALLAS:
+        raise ValueError(
+            "ragged_paged_attention needs jax.experimental.pallas, which "
+            "this jax build lacks — drop use_kernel=True to serve on the "
+            "lax fallback"
+        )
+    B, n_head, T, hs = q.shape
+    if B != 1:
+        raise ValueError(
+            f"ragged_paged_attention packs every slot into one ragged "
+            f"batch: q must be (1, n_head, T, hs), got leading dim {B}"
+        )
+    k_arr, k_sc = _pool_parts(k_pool)
+    v_arr, v_sc = _pool_parts(v_pool)
+    quantized = k_sc is not None
+    NB, BS, G, _ = k_arr.shape
+    S, MB = block_tables.shape
+    if n_head % G != 0:
+        raise ValueError(
+            f"n_head={n_head} must be a multiple of the pool's KV groups "
+            f"G={G} (GQA grouping)"
+        )
+    rp = (params or KernelParams()).resolved(BS, G, hs)
+    # under the tp shard_map this builder sees the LOCAL group count; a
+    # globally-resolved pack factor folds down to what still divides
+    rp = KernelParams(
+        kv_step=rp.kv_step,
+        q_pack=math.gcd(int(rp.q_pack or 1), G),
+        scratch_width=rp.scratch_width,
+    )
+    problems = validate_kernel_params(rp, BS, G, hs)
+    if problems:
+        raise ValueError(
+            "ragged_paged_attention: invalid kernel tuning parameters — "
+            + "; ".join(problems)
+            + " (fix the tuning-table entry, or pass params=KernelParams(...))"
+        )
+    kv_step = int(rp.kv_step)
+    spb = BS // kv_step  # grid sub-steps per paged block
+
+    tables = block_tables.astype(jnp.int32)
+    qstart = q_start.astype(jnp.int32)
+    qlen = q_len.astype(jnp.int32)
+    lens32 = lens.astype(jnp.int32)
+    qpos2d = q_pos.astype(jnp.int32).reshape(1, T)
+
+    def kv_index(sidx, i, tables_ref, qstart_ref, qlen_ref, lens_ref):
+        # unneeded grid steps remap to (trash) block 0: the DMA still
+        # happens (the grid is static) but never re-reads a far block
+        needed = jnp.logical_and(
+            qlen_ref[sidx] > 0, i * kv_step < lens_ref[sidx]
+        )
+        blk = jnp.where(needed, tables_ref[sidx, i // spb], 0)
+        return (blk, i % spb, 0, 0)
+
+    def scale_index(sidx, i, tables_ref, qstart_ref, qlen_ref, lens_ref):
+        needed = jnp.logical_and(
+            qlen_ref[sidx] > 0, i * kv_step < lens_ref[sidx]
+        )
+        blk = jnp.where(needed, tables_ref[sidx, i // spb], 0)
+        return (blk, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, T), lambda s, i, *_: (0, 0)),  # q_pos
+        pl.BlockSpec((1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)),
+        pl.BlockSpec((1, kv_step, G, hs), kv_index),
+        pl.BlockSpec((1, kv_step, G, hs), kv_index),
+    ]
+    operands = [qpos2d, q, k_arr, v_arr]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, G), scale_index)] * 2
+        operands += [k_sc, v_sc]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(S, MB * spb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, n_head, T, hs), lambda s, i, *_: (0, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_head * T, rp.scratch_width), jnp.float32),
+            pltpu.VMEM((n_head * T, rp.scratch_width), jnp.float32),
+            pltpu.VMEM((n_head * T, hs), jnp.float32),
+        ],
+    )
+    kern = functools.partial(
+        _unified_kernel,
+        kv_step=kv_step, n_groups=G, n_tokens=T, scale=scale,
+        q_pack=int(rp.q_pack), quantized=quantized,
+    )
+    with jax.named_scope("ragged_paged_attention"):
+        return pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((1, n_head, T, hs), q.dtype),
+            interpret=interpret,
+        )(tables, qstart, qlen, lens32, *operands)

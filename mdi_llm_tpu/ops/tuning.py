@@ -1,0 +1,518 @@
+"""Tuning tables for the unified ragged paged-attention kernel.
+
+The kernel (`ops/ragged_paged_attention.py`) is shaped by three block/grid
+parameters that trade VMEM residency against grid occupancy:
+
+- ``kv_step`` — KV tokens streamed per grid iteration.  Must divide the
+  pool's ``block_size`` (each table-resolved block is walked in
+  ``block_size // kv_step`` sub-steps); ``None`` means one whole block per
+  step.
+- ``q_pack`` — head-packing factor: how many KV groups fold into one
+  block-diagonal matmul so (head, query) rows fill full 8x128 sublanes
+  when ``head_size`` underfills a lane tile (pythia-14m / tiny-llama
+  class).  Must divide ``n_query_groups``; ``None`` means the largest
+  divisor with ``q_pack * head_size <= 128``.
+- ``scratch_width`` — lane width of the online-softmax m/l VMEM scratch
+  rows (the kernel reads column 0; the width is a layout choice).
+
+Resolution (`resolve_kernel_params`) is HOST-side and deterministic per
+process, so the chosen parameters are compile-time static — the serving
+engine pays zero post-warmup recompiles for them.  Precedence:
+
+1. explicit ``params=`` at the call site,
+2. a user tuning table (JSON artifact written by ``mdi-tune``), found via
+   the ``MDI_TUNE_TABLE`` env var or an explicit path,
+3. the committed per-generation defaults below (v4/v5e/v5p/v6e, the same
+   normalization as ``obs/roofline.DEVICE_PEAKS``),
+4. conservative defaults for unknown devices — never a guess.
+
+``mdi-tune`` sweeps the candidate grid on-device for one model geometry
+and persists the winner as a JSON table; `mdi-audit`'s
+``bad-kernel-tuning`` check validates any table entry (divisibility, VMEM
+budget vs `obs/roofline.device_vmem_bytes`) before anything compiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "TUNE_TABLE_ENV",
+    "KernelParams",
+    "DEFAULT_PARAMS",
+    "BUILTIN_TUNING_TABLES",
+    "default_q_pack",
+    "geometry_key",
+    "resolve_kernel_params",
+    "validate_kernel_params",
+    "estimate_kernel_vmem",
+    "load_tuning_table",
+    "save_tuning_table",
+    "candidate_params",
+    "autotune",
+    "main",
+]
+
+# env var naming a user tuning-table JSON (the `mdi-tune --out` artifact);
+# wins over the committed defaults for every geometry it covers
+TUNE_TABLE_ENV = "MDI_TUNE_TABLE"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelParams:
+    """One tuning-table entry.  ``None`` fields mean "derive from the
+    geometry" (see module docstring); `resolved` pins them to ints."""
+
+    kv_step: Optional[int] = None
+    q_pack: Optional[int] = None
+    scratch_width: int = 128
+
+    def resolved(
+        self, block_size: int, n_groups: int, head_size: int
+    ) -> "KernelParams":
+        """Concrete ints for one pool geometry: ``kv_step=None`` becomes
+        the full block, ``q_pack=None`` the auto packing factor."""
+        return KernelParams(
+            kv_step=int(self.kv_step or block_size),
+            q_pack=int(self.q_pack or default_q_pack(n_groups, head_size)),
+            scratch_width=int(self.scratch_width),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KernelParams":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# the conservative entry: whole-block KV steps, geometry-derived head
+# packing, one full lane of scratch — correct on anything, tuned for
+# nothing.  Unknown device kinds resolve to exactly this.
+DEFAULT_PARAMS = KernelParams(kv_step=None, q_pack=None, scratch_width=128)
+
+# Committed per-generation defaults, ``obs/roofline.DEVICE_PEAKS``
+# semantics: keyed by the normalized device kind, then by geometry key
+# (`geometry_key`) with ``"*"`` as the any-geometry row.  These are the
+# defaults `mdi-tune` measures AGAINST — bench's kernel-paged row reports
+# tuned-vs-default per variant.  All four generations currently commit
+# the conservative entry; a measured win lands here as an exact-geometry
+# row, never by loosening ``"*"``.
+BUILTIN_TUNING_TABLES: Dict[str, Dict[str, Dict[str, Any]]] = {
+    "v4": {"*": DEFAULT_PARAMS.to_dict()},
+    "v5e": {"*": DEFAULT_PARAMS.to_dict()},
+    "v5p": {"*": DEFAULT_PARAMS.to_dict()},
+    "v6e": {"*": DEFAULT_PARAMS.to_dict()},
+}
+
+
+def default_q_pack(n_groups: int, head_size: int) -> int:
+    """Largest packing factor p dividing ``n_groups`` with
+    ``p * head_size <= 128`` (one lane tile); 1 when ``head_size`` already
+    fills a lane.  pythia-14m (G=4, hs=32) packs 4; tiny-llama (G=4,
+    hs=64) packs 2; anything with hs >= 128 packs 1."""
+    best = 1
+    for p in range(1, n_groups + 1):
+        if n_groups % p == 0 and p * head_size <= 128:
+            best = p
+    return best
+
+
+def geometry_key(
+    n_head: int,
+    n_groups: int,
+    head_size: int,
+    kv_dtype: Optional[str],
+    block_size: int,
+) -> str:
+    """The tuning-table row key for one attention geometry."""
+    kv = kv_dtype or "fp"
+    return f"{n_head}h{n_groups}g{head_size}hs/{kv}/bs{block_size}"
+
+
+def load_tuning_table(path: str) -> Dict[str, Any]:
+    """Read an `mdi-tune` JSON artifact: ``{"device_kind": ...,
+    "entries": {geometry_key: params_dict}}`` (a bare entries mapping is
+    accepted too)."""
+    with open(path) as f:
+        d = json.load(f)
+    if not isinstance(d, dict):
+        raise ValueError(f"tuning table {path}: expected a JSON object")
+    if "entries" in d:
+        return d
+    return {"device_kind": None, "entries": d}
+
+
+def save_tuning_table(
+    path: str,
+    device_kind: Optional[str],
+    entries: Dict[str, Dict[str, Any]],
+    timings_us: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Persist a tuning table as the `mdi-tune` JSON artifact."""
+    doc: Dict[str, Any] = {"device_kind": device_kind, "entries": entries}
+    if timings_us:
+        doc["timings_us"] = timings_us
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _lookup(entries: Dict[str, Any], key: str) -> Optional[Dict[str, Any]]:
+    if key in entries:
+        return entries[key]
+    return entries.get("*")
+
+
+def resolve_kernel_params(
+    n_head: int,
+    n_groups: int,
+    head_size: int,
+    block_size: int,
+    kv_dtype: Optional[str] = None,
+    device_kind: Optional[str] = None,
+    table_path: Optional[str] = None,
+    params: Optional[KernelParams] = None,
+) -> Tuple[KernelParams, Dict[str, Any]]:
+    """Pick the kernel parameters for one geometry, host-side.
+
+    Returns ``(resolved KernelParams, meta)`` with
+    ``meta = {"tuned", "table_source", "key"}``.  ``tuned`` is True only
+    when a user tuning table supplied the entry; the committed builtin
+    defaults and the conservative fallback both report ``tuned=False``.
+    The lookup is pure host computation on static values — resolving at
+    trace time adds zero recompiles.
+    """
+    from mdi_llm_tpu.obs.roofline import normalize_device_kind
+
+    key = geometry_key(n_head, n_groups, head_size, kv_dtype, block_size)
+    meta: Dict[str, Any] = {"tuned": False, "table_source": None, "key": key}
+    if params is not None:
+        meta["table_source"] = "explicit"
+        return params.resolved(block_size, n_groups, head_size), meta
+    path = table_path or os.environ.get(TUNE_TABLE_ENV)
+    if path:
+        table = load_tuning_table(path)  # a bad path/file should be loud
+        entry = _lookup(table.get("entries", {}), key)
+        if entry is not None:
+            meta["tuned"] = True
+            meta["table_source"] = f"file:{path}"
+            return (
+                KernelParams.from_dict(entry).resolved(
+                    block_size, n_groups, head_size
+                ),
+                meta,
+            )
+    norm = normalize_device_kind(device_kind)
+    if norm:
+        entry = _lookup(BUILTIN_TUNING_TABLES[norm], key)
+        if entry is not None:
+            meta["table_source"] = f"builtin:{norm}"
+            return (
+                KernelParams.from_dict(entry).resolved(
+                    block_size, n_groups, head_size
+                ),
+                meta,
+            )
+    meta["table_source"] = "conservative"
+    return DEFAULT_PARAMS.resolved(block_size, n_groups, head_size), meta
+
+
+def validate_kernel_params(
+    params: KernelParams,
+    block_size: int,
+    n_groups: int,
+    head_size: int,
+) -> List[str]:
+    """Problems with a RESOLVED entry for one geometry, as actionable
+    strings (empty = valid).  The kernel builder raises on these; mdi-audit
+    reports them as ``bad-kernel-tuning`` errors before any compile."""
+    problems: List[str] = []
+    kv = params.kv_step or 0
+    if kv < 1 or block_size % kv != 0:
+        problems.append(
+            f"kv_step={params.kv_step} must be a positive divisor of "
+            f"block_size={block_size} (each paged block is walked in "
+            "block_size/kv_step sub-steps)"
+        )
+    qp = params.q_pack or 0
+    if qp < 1 or n_groups % qp != 0:
+        problems.append(
+            f"q_pack={params.q_pack} must be a positive divisor of "
+            f"n_query_groups={n_groups} (it folds whole KV groups into "
+            "one block-diagonal matmul)"
+        )
+    if params.scratch_width < 1:
+        problems.append(
+            f"scratch_width={params.scratch_width} must be >= 1 (lane "
+            "width of the online-softmax m/l scratch; 128 is one lane)"
+        )
+    return problems
+
+
+def estimate_kernel_vmem(
+    n_head: int,
+    n_groups: int,
+    head_size: int,
+    n_tokens: int,
+    block_size: int,
+    params: KernelParams,
+    kv_dtype: Optional[str] = None,
+) -> int:
+    """Conservative VMEM footprint of one kernel instance in bytes: the
+    packed q block + output, double-buffered K/V (+scale) sub-blocks, the
+    per-token position vector, and the online-softmax scratch.  Audited
+    against `obs/roofline.device_vmem_bytes` by ``bad-kernel-tuning``."""
+    p = params.resolved(block_size, n_groups, head_size)
+    rows = n_head * n_tokens
+    kv_item = 1 if kv_dtype == "int8" else 4
+    q_bytes = n_head * n_tokens * head_size * 4  # q block, f32 upper bound
+    out_bytes = q_bytes
+    # K and V sub-blocks, x2 for pipelined double buffering
+    kv_bytes = 2 * 2 * (p.kv_step or block_size) * n_groups * head_size
+    kv_bytes *= kv_item
+    scale_bytes = (2 * 2 * n_groups * 4) if kv_dtype == "int8" else 0
+    qpos_bytes = n_tokens * 4
+    scratch = 2 * rows * p.scratch_width * 4 + rows * head_size * 4
+    return q_bytes + out_bytes + kv_bytes + scale_bytes + qpos_bytes + scratch
+
+
+# ---------------------------------------------------------------------------
+# on-device sweep (mdi-tune)
+# ---------------------------------------------------------------------------
+
+
+def candidate_params(
+    block_size: int, n_groups: int, head_size: int
+) -> List[KernelParams]:
+    """The sweep grid for one geometry: every kv_step that divides
+    block_size (>= 8 where possible), every q_pack dividing n_query_groups
+    that fits a lane tile, one-lane scratch."""
+    kv_steps = [
+        d
+        for d in range(1, block_size + 1)
+        if block_size % d == 0 and (d >= 8 or d == block_size)
+    ]
+    q_packs = [
+        p
+        for p in range(1, n_groups + 1)
+        if n_groups % p == 0 and (p == 1 or p * head_size <= 128)
+    ]
+    return [
+        KernelParams(kv_step=kv, q_pack=qp, scratch_width=128)
+        for kv in kv_steps
+        for qp in q_packs
+    ]
+
+
+def _make_case(n_head, n_groups, head_size, block_size, max_blocks,
+               n_tokens, n_slots, kv_dtype):
+    """Deterministic synthetic ragged batch: a mixed decode+prefill span
+    layout over a shuffled paged pool, the exact operand set the unified
+    kernel takes."""
+    import jax
+    import jax.numpy as jnp
+
+    num_blocks = 1 + n_slots * max_blocks
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(
+        kq, (1, n_head, n_tokens, head_size), dtype=jnp.float32
+    )
+    k_pool = jax.random.normal(
+        kk, (num_blocks, block_size, n_groups, head_size), dtype=jnp.float32
+    )
+    v_pool = jax.random.normal(
+        kv_, (num_blocks, block_size, n_groups, head_size), dtype=jnp.float32
+    )
+    if kv_dtype == "int8":
+        def quant(pool):
+            s = jnp.max(jnp.abs(pool), axis=(1, 3)) / 127.0  # (NB, G)
+            qv = jnp.round(pool / s[:, None, :, None]).astype(jnp.int8)
+            return {"q": qv, "scale": s.astype(jnp.float32)}
+
+        k_pool, v_pool = quant(k_pool), quant(v_pool)
+    tables = (
+        1 + jnp.arange(n_slots * max_blocks, dtype=jnp.int32)
+    ).reshape(n_slots, max_blocks)
+    # spans: slot 0 takes the leftover-width "prefill" run, the rest are
+    # single-token decode lanes — the serving engine's mixed-step shape
+    decode = n_slots - 1
+    first = n_tokens - decode
+    q_len = jnp.array([first] + [1] * decode, dtype=jnp.int32)
+    q_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         first + jnp.arange(decode, dtype=jnp.int32)]
+    )
+    window = max_blocks * block_size
+    pos = [jnp.arange(first, dtype=jnp.int32)]
+    pos += [jnp.full((1,), window - 1 - i, jnp.int32) for i in range(decode)]
+    q_pos = jnp.concatenate(pos)
+    lens = jnp.maximum(
+        q_pos[jnp.clip(q_start, 0, n_tokens - 1)] + q_len, 1
+    ).astype(jnp.int32)
+    return q, k_pool, v_pool, tables, q_start, q_len, lens, q_pos
+
+
+def _time_us(fn, reps: int) -> float:
+    """Best-of-reps wall time of `fn()` in microseconds.  The device sync
+    per rep is the measurement, not a hazard."""
+    fn().block_until_ready()  # mdi-lint: disable=host-sync -- warmup; timing harness
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn().block_until_ready()  # mdi-lint: disable=host-sync -- the sync IS the measurement
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e6
+
+
+def autotune(
+    n_head: int,
+    n_groups: int,
+    head_size: int,
+    block_size: int = 16,
+    max_blocks: int = 8,
+    n_tokens: int = 64,
+    n_slots: int = 4,
+    kv_dtype: Optional[str] = None,
+    reps: int = 10,
+    interpret: Optional[bool] = None,
+) -> Tuple[KernelParams, List[Dict[str, Any]]]:
+    """Sweep `candidate_params` for one geometry on the current backend
+    and return ``(winner, results)``; results rows carry ``params`` and
+    ``us``.  Off-TPU the sweep runs the kernel in interpret mode — the
+    timings are meaningless for performance but exercise every candidate,
+    which is what CPU CI wants."""
+    import jax
+
+    from mdi_llm_tpu.ops.ragged_paged_attention import ragged_paged_attention
+
+    with jax.named_scope("mdi_tune_autotune"):
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        case = _make_case(
+            n_head, n_groups, head_size, block_size, max_blocks,
+            n_tokens, n_slots, kv_dtype,
+        )
+        q, k_pool, v_pool, tables, q_start, q_len, lens, q_pos = case
+        results: List[Dict[str, Any]] = []
+        for cand in candidate_params(block_size, n_groups, head_size):
+            fn = jax.jit(  # mdi-lint: disable=jit-in-loop -- one compile per candidate IS the sweep
+                lambda q_, cand_=cand: ragged_paged_attention(
+                    q_, k_pool, v_pool, tables, q_start, q_len, lens, q_pos,
+                    scale=1.0 / head_size ** 0.5, params=cand_,
+                    interpret=interpret,
+                )
+            )
+            us = _time_us(lambda: fn(q), reps)
+            results.append({"params": cand.to_dict(), "us": us})
+        best = min(results, key=lambda r: r["us"])
+    return KernelParams.from_dict(best["params"]), results
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``mdi-tune``: sweep the unified ragged paged-attention kernel's
+    block/grid parameters for one model geometry on THIS device and
+    persist the winner as a JSON tuning table (read back via
+    ``MDI_TUNE_TABLE`` or ``--table`` paths elsewhere)."""
+    ap = argparse.ArgumentParser(
+        prog="mdi-tune",
+        description=(
+            "Autotune the unified ragged paged-attention kernel "
+            "(kv_step / q_pack / scratch_width) for one model geometry on "
+            "the current device, and write the winning entries as a JSON "
+            "tuning table.  Point MDI_TUNE_TABLE at the artifact to serve "
+            "with it; serving resolves the table at trace time, so tuned "
+            "parameters add zero post-warmup recompiles."
+        ),
+    )
+    ap.add_argument(
+        "--model", default=None,
+        help="model config name (Config.from_name) supplying "
+        "n_head/n_query_groups/head_size; overridden by the explicit "
+        "geometry flags below",
+    )
+    ap.add_argument("--n-head", type=int, default=None,
+                    help="attention heads (with --n-kv-heads/--head-size)")
+    ap.add_argument("--n-kv-heads", type=int, default=None,
+                    help="KV groups (n_query_groups)")
+    ap.add_argument("--head-size", type=int, default=None,
+                    help="per-head dimension")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="paged-KV block size (ServingConfig.block_size)")
+    ap.add_argument("--kv-dtype", default="fp", choices=["fp", "int8"],
+                    help="pool dtype family to tune for")
+    ap.add_argument("--tokens", type=int, default=64,
+                    help="packed query tokens in the sweep batch")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="ragged slots in the sweep batch")
+    ap.add_argument("--max-blocks", type=int, default=8,
+                    help="blocks per slot table in the sweep batch")
+    ap.add_argument("--reps", type=int, default=10,
+                    help="timing repetitions per candidate (best-of)")
+    ap.add_argument("--out", default="mdi-tune.json",
+                    help="tuning-table JSON artifact to write")
+    ap.add_argument(
+        "--interpret", action="store_true",
+        help="force Pallas interpret mode (the off-TPU default; timings "
+        "then rank the interpreter, not the hardware)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.model:
+        from mdi_llm_tpu.config import Config
+
+        cfg = Config.from_name(args.model)
+        n_head = args.n_head or cfg.n_head
+        n_groups = args.n_kv_heads or cfg.n_query_groups
+        head_size = args.head_size or cfg.head_size
+    else:
+        if None in (args.n_head, args.n_kv_heads, args.head_size):
+            ap.error("pass --model NAME or all of --n-head/--n-kv-heads/"
+                     "--head-size")
+        n_head, n_groups = args.n_head, args.n_kv_heads
+        head_size = args.head_size
+
+    import jax
+
+    device = jax.devices()[0]
+    kv_dtype = None if args.kv_dtype == "fp" else args.kv_dtype
+    interpret = True if args.interpret else None
+    best, results = autotune(
+        n_head, n_groups, head_size,
+        block_size=args.block_size, max_blocks=args.max_blocks,
+        n_tokens=args.tokens, n_slots=args.slots, kv_dtype=kv_dtype,
+        reps=args.reps, interpret=interpret,
+    )
+    key = geometry_key(n_head, n_groups, head_size, kv_dtype,
+                       args.block_size)
+    default_us = next(
+        (r["us"] for r in results
+         if KernelParams.from_dict(r["params"])
+         == DEFAULT_PARAMS.resolved(args.block_size, n_groups, head_size)),
+        None,
+    )
+    save_tuning_table(
+        args.out, device.device_kind, {key: best.to_dict()},
+        timings_us={key: results},
+    )
+    for r in sorted(results, key=lambda r: r["us"]):
+        mark = " <-- best" if r["params"] == best.to_dict() else ""
+        print(f"  {r['params']}  {r['us']:10.1f} us{mark}")
+    if default_us:
+        best_us = min(r["us"] for r in results)
+        print(f"tuned vs default: {default_us / best_us:.2f}x "
+              f"({best_us:.1f} vs {default_us:.1f} us)")
+    print(f"{key} on {device.device_kind}: wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

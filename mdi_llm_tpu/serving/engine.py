@@ -467,6 +467,36 @@ class ServingEngine:
         re-key so two engines of one Generator never share executables."""
         return ("serve", self.cfg.use_kernel)
 
+    def kernel_info(self) -> Dict[str, Any]:
+        """The attention route this engine's dispatches resolve to, plus
+        the tuning-table provenance (`ops/tuning.py`): ``{"variant":
+        "unified"|"fallback", "tuned", "table_source", "params"}``.  bench
+        serve rows record it as ``detail.kernel``.  Pure host-side lookup
+        — the same trace-time resolution the dispatch runs, so calling it
+        never traces, compiles, or touches the pool."""
+        from mdi_llm_tpu.ops.paged_attention import _kernel_auto
+        from mdi_llm_tpu.ops.tuning import resolve_kernel_params
+
+        cfg = self.gen.cfg
+        use_kernel = self.cfg.use_kernel
+        if use_kernel is None:
+            use_kernel = _kernel_auto(self._paged_shard)
+        device_kind = None
+        if jax.default_backend() == "tpu":
+            device_kind = jax.devices()[0].device_kind
+        params, meta = resolve_kernel_params(
+            n_head=cfg.n_head, n_groups=cfg.n_query_groups,
+            head_size=cfg.head_size, block_size=self.cfg.block_size,
+            kv_dtype="int8" if self._pool_dtype == "int8" else None,
+            device_kind=device_kind,
+        )
+        return {
+            "variant": "unified" if use_kernel else "fallback",
+            "tuned": meta["tuned"],
+            "table_source": meta["table_source"],
+            "params": params.to_dict(),
+        }
+
     def _init_pool(self, num_blocks: int, bs: int):
         """Allocate and place the device-side paged pool.  The base
         engine's flat (L, num_blocks, bs, G, hs) pool, tp-sharded along

@@ -304,6 +304,64 @@ def test_bad_token_budget_rejected():
     assert r.breakdown["kv_pool"]["token_budget"] == 64
 
 
+def test_kernel_tuning_breakdown_fields_always_present():
+    """Every serving plan's kv_pool breakdown records the kernel route and
+    tuning provenance — auto dispatch with no table resolves the
+    conservative entry, tuned=False."""
+    r = audit_plan(PlanSpec(cfg=tiny(), serving=ServingConfig(block_size=4)))
+    pool = r.breakdown["kv_pool"]
+    assert pool["kernel_variant"] == "auto"
+    assert pool["tuned"] is False
+    assert pool["kernel_table_source"] == "conservative"
+    assert pool["kernel_params"]["kv_step"] == 4  # resolved: whole block
+    r2 = audit_plan(PlanSpec(
+        cfg=tiny(), serving=ServingConfig(block_size=4, use_kernel=False)
+    ))
+    assert r2.breakdown["kv_pool"]["kernel_variant"] == "fallback"
+
+
+def test_bad_kernel_tuning_table_entry(tmp_path, monkeypatch):
+    """A user tuning table whose entry cannot run on the plan's geometry
+    (kv_step not dividing block_size) is an ERROR before anything compiles
+    — even under auto dispatch, because tuned entries are on the route."""
+    from mdi_llm_tpu.ops.tuning import TUNE_TABLE_ENV, save_tuning_table
+
+    path = tmp_path / "tuned.json"
+    save_tuning_table(str(path), "v5e", {"*": {"kv_step": 5}})
+    monkeypatch.setenv(TUNE_TABLE_ENV, str(path))
+    r = audit_plan(PlanSpec(cfg=tiny(), serving=ServingConfig(block_size=4)))
+    assert "bad-kernel-tuning" in codes(r)
+    assert any("kv_step=5" in f.message for f in r.findings)
+    assert r.breakdown["kv_pool"]["tuned"] is True
+
+
+def test_bad_kernel_tuning_vmem_overage(tmp_path, monkeypatch):
+    """A tuned scratch_width whose VMEM estimate exceeds the device budget
+    errors with the budget named — before the kernel ever compiles."""
+    from mdi_llm_tpu.ops.tuning import TUNE_TABLE_ENV, save_tuning_table
+
+    path = tmp_path / "tuned.json"
+    save_tuning_table(str(path), "v5e", {"*": {"scratch_width": 1 << 22}})
+    monkeypatch.setenv(TUNE_TABLE_ENV, str(path))
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=4, use_kernel=True),
+    ))
+    assert "bad-kernel-tuning" in codes(r)
+    assert any("VMEM" in f.message for f in r.findings)
+
+
+def test_unreadable_tuning_table_is_loud(tmp_path, monkeypatch):
+    """MDI_TUNE_TABLE pointing at a missing/corrupt file is a finding, not
+    a silent fall-through to defaults the user did not ask for."""
+    from mdi_llm_tpu.ops.tuning import TUNE_TABLE_ENV
+
+    monkeypatch.setenv(TUNE_TABLE_ENV, str(tmp_path / "missing.json"))
+    r = audit_plan(PlanSpec(cfg=tiny(), serving=ServingConfig(block_size=4)))
+    assert "bad-kernel-tuning" in codes(r)
+    assert any("cannot be read" in f.message for f in r.findings)
+
+
 def test_pool_estimate_byte_exact_vs_live_engine_with_chunk_reservations():
     """The audited kv_pool bytes must equal the live engine's allocated
     pool byte-for-byte when chunked decode / speculative verify are on —

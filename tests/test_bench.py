@@ -375,6 +375,17 @@ def test_kernel_mode_smoke():
     for op in ("decode", "ragged", "prefill"):
         assert grid[f"{op}-fp"]["dense_us"] > 0
         assert grid[f"{op}-int8"]["kernel_us"] is None  # CPU: no Pallas
+        assert grid[f"{op}-int8"]["kernel_default_us"] is None
+    # tuned-vs-default provenance rides the row even off-TPU: no table,
+    # no device -> the conservative resolution, fully-resolved params
+    tuning = out["detail"]["tuning"]
+    for tag in ("fp", "int8"):
+        assert tuning[tag]["tuned"] is False
+        assert tuning[tag]["table_source"] == "conservative"
+        assert tuning[tag]["params"]["kv_step"] >= 1
+        assert tuning[tag]["default_params"] == {
+            "kv_step": None, "q_pack": None, "scratch_width": 128}
+    assert tuning["int8"]["key"].split("/")[1] == "int8"
 
 
 def test_serve_pool_mib_doubles_int8_blocks():

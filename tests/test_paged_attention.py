@@ -11,6 +11,7 @@ import pytest
 
 from mdi_llm_tpu.ops.attention import multihead_attention
 from mdi_llm_tpu.ops.paged_attention import (
+    KernelParams,
     gather_paged_kv,
     paged_attention,
     paged_prefill,
@@ -105,11 +106,8 @@ def test_ragged_multiquery_kernel_matches_fallback(heads, starts):
     each sequence attending with Tq query tokens at its OWN absolute
     positions, the speculative-verify shape — must agree with the exact
     gather fallback, which itself is bit-equal to the dense op."""
-    from mdi_llm_tpu.ops.paged_attention import RAGGED_KERNEL_MAX_TQ
-
     H, G = heads
     B, hs, S, BS, Tq = len(starts), 16, 32, 8, 5
-    assert Tq <= RAGGED_KERNEL_MAX_TQ
     q, k, v = rand_qkv(B, H, G, S, hs, Tq=Tq, seed=7)
     pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
     q_pos = jnp.asarray([np.arange(s, s + Tq) for s in starts], jnp.int32)
@@ -142,23 +140,23 @@ def test_ragged_kernel_crossing_block_boundary():
     )
 
 
-def test_wide_tq_stays_on_fallback():
-    """Prefill-width Tq must take the gather fallback even with
-    use_kernel=True: the ragged kernel's VMEM scratch scales with
-    n_head*Tq and is capped at RAGGED_KERNEL_MAX_TQ."""
-    from mdi_llm_tpu.ops.paged_attention import RAGGED_KERNEL_MAX_TQ
-
-    B, H, G, hs, S, BS = 1, 4, 2, 8, 64, 8
-    Tq = RAGGED_KERNEL_MAX_TQ + 1
+def test_wide_tq_runs_through_kernel():
+    """Prefill-width Tq (wider than the old RAGGED_KERNEL_MAX_TQ=16 cap
+    the legacy ragged kernel silently fell back at) now runs THROUGH the
+    unified kernel with use_kernel=True and matches the fallback — the
+    silent-degradation cliff is gone."""
+    B, H, G, hs, S, BS = 2, 4, 2, 8, 64, 8
+    Tq = 33  # > the old cap
     q, k, v = rand_qkv(B, H, G, S, hs, Tq=Tq, seed=1)
     pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
-    q_pos = jnp.asarray([np.arange(Tq)], jnp.int32)
+    q_pos = jnp.asarray([np.arange(Tq), np.arange(20, 20 + Tq)], jnp.int32)
     ref = paged_attention(q, pool_k, pool_v, tables, q_pos, use_kernel=False)
     got = paged_attention(
         q, pool_k, pool_v, tables, q_pos, use_kernel=True, interpret=True
     )
-    # identical (not just close): both routes are the same lax fallback
-    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5
+    )
 
 
 def _pack_mixed(slots_spec, H, hs, T, seed=0):
@@ -496,3 +494,205 @@ def test_q8_prefill_kernel_matches_fallback(heads):
         np.asarray(ref)[0, :, :off], np.asarray(got)[0, :, :off],
         rtol=2e-5, atol=2e-5,
     )
+
+# ---------------------------------------------------------------------------
+# unified-kernel property grid: ONE kernel serves every (q_len, q_pos) mix
+# (mha/gqa/mqa x fp/int8 x Tq x ragged mixed spans), pinned against the
+# fallback (which the fp rows pin against dense bit-for-bit above)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv", ["fp", "int8"])
+@pytest.mark.parametrize("Tq", [1, 7, 16, 33])
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1)],
+                         ids=["mha", "gqa", "mqa"])
+def test_unified_kernel_property_grid(heads, Tq, kv):
+    """The tentpole contract: decode (Tq=1), narrow and exactly-at-the-old-
+    cap verifies (7, 16), and beyond-the-old-cap width (33) all run the
+    SAME kernel and agree with the exact fallback at both pool dtypes."""
+    H, G = heads
+    B, hs, S, BS = 2, 16, 64, 8
+    q, k, v = rand_qkv(B, H, G, S, hs, Tq=Tq, seed=Tq)
+    if kv == "int8":
+        kp, vp, tables = build_q8_pool(np.asarray(k), np.asarray(v), BS)
+    else:
+        kp, vp, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    starts = [3, S - Tq]
+    q_pos = jnp.asarray([np.arange(s, s + Tq) for s in starts], jnp.int32)
+    ref = paged_attention(q, kp, vp, tables, q_pos, use_kernel=False)
+    got = paged_attention(q, kp, vp, tables, q_pos, use_kernel=True,
+                          interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5
+    )
+    if kv == "fp":
+        # the fallback anchor: dense softmax chain bit-for-bit
+        dense = multihead_attention(q, k, v, q_pos)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(dense))
+
+
+@pytest.mark.parametrize("kv", ["fp", "int8"])
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1)],
+                         ids=["mha", "gqa", "mqa"])
+def test_unified_kernel_mixed_span_widths(heads, kv):
+    """One packed batch mixing every grid width at once — a decode lane, a
+    7-token verify, a 16-token chunk and a 33-token prefill run — through
+    the one kernel; every real row agrees with the fallback."""
+    H, G = heads
+    hs, S, BS = 16, 64, 8
+    T = 1 + 7 + 16 + 33 + 3  # + 3 padding rows
+    q, k, v = rand_qkv(4, H, G, S, hs, Tq=1, seed=29)
+    if kv == "int8":
+        kp, vp, tables = build_q8_pool(np.asarray(k), np.asarray(v), BS)
+    else:
+        kp, vp, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    qp, q_slot, q_start, q_len, q_pos, off = _pack_mixed(
+        [(0, 50, 1), (1, 12, 7), (2, 30, 16), (3, 0, 33)], H, hs, T, seed=31
+    )
+    ref = paged_prefill(qp, kp, vp, tables, q_slot, q_start, q_len, q_pos,
+                        use_kernel=False)
+    got = paged_prefill(qp, kp, vp, tables, q_slot, q_start, q_len, q_pos,
+                        use_kernel=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref)[0, :, :off], np.asarray(got)[0, :, :off],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize(
+    "params",
+    [
+        KernelParams(kv_step=8, q_pack=1),   # sub-block KV walk, no packing
+        KernelParams(kv_step=4, q_pack=2),   # finer walk + explicit packing
+        KernelParams(kv_step=None, q_pack=None, scratch_width=256),
+    ],
+    ids=["kv8-qp1", "kv4-qp2", "wide-scratch"],
+)
+def test_explicit_params_keep_parity(params):
+    """Tuned-table entries change LAYOUT only: any valid (kv_step, q_pack,
+    scratch_width) choice must agree with the fallback — the autotuner can
+    never trade correctness for speed."""
+    H, G, hs, S, BS, Tq = 8, 2, 16, 32, 16, 5
+    q, k, v = rand_qkv(2, H, G, S, hs, Tq=Tq, seed=37)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    q_pos = jnp.asarray([np.arange(3, 3 + Tq), np.arange(27 - Tq, 27)],
+                        jnp.int32)
+    ref = paged_attention(q, pool_k, pool_v, tables, q_pos, use_kernel=False)
+    got = paged_attention(q, pool_k, pool_v, tables, q_pos, use_kernel=True,
+                          interpret=True, params=params)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_invalid_params_raise_actionably():
+    """use_kernel=True with an entry the geometry cannot run must RAISE
+    with the problem named — never silently fall back (the old cap's
+    failure mode) and never compile garbage."""
+    H, G, hs, S, BS = 4, 2, 8, 32, 16
+    q, k, v = rand_qkv(1, H, G, S, hs, Tq=1, seed=41)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    q_pos = jnp.asarray([[9]], jnp.int32)
+    with pytest.raises(ValueError, match="kv_step=5"):
+        paged_attention(q, pool_k, pool_v, tables, q_pos, use_kernel=True,
+                        interpret=True, params=KernelParams(kv_step=5))
+    with pytest.raises(ValueError, match="scratch_width"):
+        paged_attention(q, pool_k, pool_v, tables, q_pos, use_kernel=True,
+                        interpret=True,
+                        params=KernelParams(scratch_width=0))
+
+
+def test_tuned_table_lookup_is_compile_free(tmp_path, monkeypatch):
+    """Tuning-table resolution happens host-side at trace time: re-running
+    the jitted op after warmup — table file present, env var set, lookup on
+    every call — performs ZERO new traces (the zero-post-warmup-recompile
+    contract of the tuned path)."""
+    from functools import partial
+
+    from mdi_llm_tpu.ops.tuning import (
+        TUNE_TABLE_ENV, geometry_key, save_tuning_table,
+    )
+    from mdi_llm_tpu.utils.profiling import CompileGuard
+
+    H, G, hs, S, BS = 4, 2, 8, 32, 8
+    key = geometry_key(H, G, hs, None, BS)
+    path = tmp_path / "tuned.json"
+    save_tuning_table(str(path), "v5e", {key: {"kv_step": 8, "q_pack": 1}})
+    monkeypatch.setenv(TUNE_TABLE_ENV, str(path))
+    q, k, v = rand_qkv(2, H, G, S, hs, Tq=1, seed=43)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+    q_pos = jnp.asarray([[13], [30]], jnp.int32)
+    fn = jax.jit(partial(paged_attention, use_kernel=True, interpret=True))
+    fn(q, pool_k, pool_v, tables, q_pos).block_until_ready()  # warmup
+    guard = CompileGuard(label="tuned-lookup")
+    with guard:
+        guard.mark_warm()
+        for _ in range(3):
+            fn(q, pool_k, pool_v, tables, q_pos).block_until_ready()
+    assert guard.traces_after_warmup == 0
+    guard.expect_clean()
+
+
+def test_prefill_fallback_bit_identical_to_old_shape():
+    """Satellite pin: the vectorized fallback (gather the pool ONCE into
+    per-slot views, index per chunk) must be BIT-identical to the old
+    per-chunk-gather shape (`pool[tables][sc] == pool[tables[sc]]`
+    row-for-row; reduction orders inside each lane are unchanged).  The
+    old algorithm is reimplemented here verbatim as the oracle."""
+    from mdi_llm_tpu.ops.paged_attention import (
+        _LAX_FALLBACK_CHUNK,
+        _paged_attention_lax,
+    )
+
+    def old_prefill_lax(q, k_pool, v_pool, block_tables, q_slot, q_pos,
+                        scale):
+        qt = q[0].transpose(1, 0, 2)[:, :, None, :]
+        T = qt.shape[0]
+        C = _LAX_FALLBACK_CHUNK
+        if T <= C:
+            out = _paged_attention_lax(
+                qt, k_pool, v_pool, block_tables[q_slot], q_pos[:, None],
+                scale,
+            )
+            return out[:, :, 0, :].transpose(1, 0, 2)[None]
+        pad = -T % C
+        qt_p = jnp.pad(qt, ((0, pad), (0, 0), (0, 0), (0, 0)))
+        slot_p = jnp.pad(q_slot, (0, pad))
+        pos_p = jnp.pad(q_pos, (0, pad))
+
+        def chunk(args):
+            qc, sc, pc = args
+            return _paged_attention_lax(
+                qc, k_pool, v_pool, block_tables[sc], pc[:, None], scale
+            )
+
+        out = jax.lax.map(chunk, (
+            qt_p.reshape(-1, C, *qt.shape[1:]),
+            slot_p.reshape(-1, C),
+            pos_p.reshape(-1, C),
+        ))
+        out = out.reshape(-1, *out.shape[2:])[:T]
+        return out[:, :, 0, :].transpose(1, 0, 2)[None]
+
+    H, G, hs, S, BS = 4, 2, 8, 64, 8
+    scale = 1.0 / hs ** 0.5
+    for kv, T, spans, seed in [
+        ("fp", 9, [(0, 13, 1), (1, 6, 5)], 47),        # short: no chunking
+        ("fp", 2 * _LAX_FALLBACK_CHUNK + 8,            # crosses chunks
+         [(0, 50, 1), (1, 0, 34), (2, 21, 1)], 53),
+        ("int8", 9, [(0, 13, 1), (1, 6, 5)], 59),
+        ("int8", 2 * _LAX_FALLBACK_CHUNK + 8,
+         [(0, 50, 1), (1, 0, 34), (2, 21, 1)], 61),
+    ]:
+        q, k, v = rand_qkv(3, H, G, S, hs, Tq=1, seed=seed)
+        if kv == "int8":
+            kp, vp, tables = build_q8_pool(np.asarray(k), np.asarray(v), BS)
+        else:
+            kp, vp, tables = build_pool(np.asarray(k), np.asarray(v), BS)
+        qp, q_slot, q_start, q_len, q_pos, _ = _pack_mixed(
+            spans, H, hs, T, seed=seed + 1
+        )
+        want = old_prefill_lax(qp, kp, vp, tables, q_slot, q_pos, scale)
+        got = paged_prefill(qp, kp, vp, tables, q_slot, q_start, q_len,
+                            q_pos, use_kernel=False)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))

@@ -313,6 +313,19 @@ def test_engine_decode_lanes_not_starved_by_long_prefill(served_model):
     assert results["short"] == want[0] and results["long"] == want[1]
 
 
+def test_engine_kernel_info_reports_route_and_tuning(served_model):
+    """The bench serve rows' `detail.kernel` provenance: on this CPU
+    backend the auto route is the lax fallback, the tuning resolution is
+    the conservative entry, and the params are fully resolved ints."""
+    cfg, params = served_model
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    info = gen.serve(block_size=4, max_batch=2).kernel_info()
+    assert info["variant"] == "fallback"  # no Pallas/TPU here
+    assert info["tuned"] is False
+    assert info["table_source"] == "conservative"
+    assert info["params"]["kv_step"] == 4  # whole-block default, resolved
+
+
 def test_engine_rejects_token_budget_at_or_below_max_batch(served_model):
     cfg, params = served_model
     gen = Generator(cfg, params, cache_dtype=jnp.float32)

@@ -304,6 +304,33 @@ def test_sharded_kernel_matches_lax_fallback(devices):
     )
 
 
+@pytest.mark.skipif(not HAS_SHARD_MAP,
+                    reason="this jax build has no jax.shard_map (the Pallas "
+                    "paged kernels cannot run per-shard without it)")
+def test_sharded_kernel_wide_tq_matches_lax_fallback(devices):
+    """Beyond-the-old-cap ragged width (Tq=33) through the shard_map-
+    wrapped unified kernel under tp=2: the packed span metadata rides
+    replicated, the q/pool head axes shard, and the head-packing factor
+    folds down to the LOCAL group count — parity with the GSPMD fallback
+    must survive all of it."""
+    from tests.test_paged_attention import build_pool, rand_qkv
+    from mdi_llm_tpu.ops.paged_attention import paged_attention
+
+    H, G, B, hs, S, bs, Tq = 4, 2, 2, 16, 64, 8, 33
+    q, k, v = rand_qkv(B, H, G, S, hs, Tq=Tq, seed=9)
+    pool_k, pool_v, tables = build_pool(np.asarray(k), np.asarray(v), bs)
+    q_pos = jnp.asarray([np.arange(Tq), np.arange(S - Tq, S)], jnp.int32)
+    mesh = make_mesh({"tp": 2}, devices[:2])
+    ref = paged_attention(q, pool_k, pool_v, tables, q_pos, use_kernel=False)
+    got = paged_attention(
+        q, pool_k, pool_v, tables, q_pos, use_kernel=True, interpret=True,
+        shard_axes=(mesh, "tp"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5
+    )
+
+
 @pytest.mark.skipif(HAS_SHARD_MAP,
                     reason="jax.shard_map present: the missing-dep refusal "
                     "gate does not apply on this build")

@@ -1,0 +1,258 @@
+"""ops/tuning.py: the unified ragged-kernel tuning tables — resolution
+precedence (explicit > user table > committed per-device defaults >
+conservative), validation/VMEM estimates (the mdi-audit substrate), the
+JSON artifact roundtrip, and the mdi-tune CLI itself (CPU interpret
+sweep).  The resolution path is pure host computation, so these run
+everywhere the package imports.
+"""
+
+import json
+
+import pytest
+
+from mdi_llm_tpu.ops.tuning import (
+    BUILTIN_TUNING_TABLES,
+    DEFAULT_PARAMS,
+    TUNE_TABLE_ENV,
+    KernelParams,
+    autotune,
+    candidate_params,
+    default_q_pack,
+    estimate_kernel_vmem,
+    geometry_key,
+    load_tuning_table,
+    main,
+    resolve_kernel_params,
+    save_tuning_table,
+    validate_kernel_params,
+)
+
+GEOM = dict(n_head=4, n_groups=2, head_size=16, block_size=8)
+
+
+# ---------------------------------------------------------------------------
+# resolution precedence
+# ---------------------------------------------------------------------------
+
+
+def test_conservative_default_when_nothing_known():
+    params, meta = resolve_kernel_params(**GEOM)
+    assert meta == {
+        "tuned": False,
+        "table_source": "conservative",
+        "key": "4h2g16hs/fp/bs8",
+    }
+    # fully resolved ints: whole-block kv step, auto packing
+    assert params.kv_step == 8
+    assert params.q_pack == default_q_pack(2, 16) == 2
+    assert params.scratch_width == 128
+
+
+def test_unknown_device_kind_is_never_a_guess():
+    params, meta = resolve_kernel_params(**GEOM, device_kind="TPU v9x")
+    assert meta["table_source"] == "conservative"
+    assert not meta["tuned"]
+    assert params == DEFAULT_PARAMS.resolved(8, 2, 16)
+
+
+@pytest.mark.parametrize(
+    "kind,norm",
+    [
+        ("TPU v4", "v4"),
+        ("TPU v5 lite", "v5e"),
+        ("TPU v5p", "v5p"),
+        ("TPU v6e", "v6e"),
+    ],
+)
+def test_builtin_tables_cover_all_generations(kind, norm):
+    params, meta = resolve_kernel_params(**GEOM, device_kind=kind)
+    assert meta["table_source"] == f"builtin:{norm}"
+    assert meta["tuned"] is False  # committed defaults are not "tuned"
+    assert params == KernelParams.from_dict(
+        BUILTIN_TUNING_TABLES[norm]["*"]
+    ).resolved(8, 2, 16)
+
+
+def test_user_table_wins_over_builtin(tmp_path, monkeypatch):
+    key = geometry_key(4, 2, 16, None, 8)
+    path = tmp_path / "tuned.json"
+    save_tuning_table(str(path), "v5e", {key: {"kv_step": 4, "q_pack": 1}})
+    monkeypatch.setenv(TUNE_TABLE_ENV, str(path))
+    params, meta = resolve_kernel_params(**GEOM, device_kind="TPU v5 lite")
+    assert meta["tuned"] is True
+    assert meta["table_source"] == f"file:{path}"
+    assert (params.kv_step, params.q_pack) == (4, 1)
+
+
+def test_user_table_misses_fall_through(tmp_path, monkeypatch):
+    path = tmp_path / "tuned.json"
+    save_tuning_table(str(path), "v5e", {"32h8g64hs/fp/bs16": {"kv_step": 8}})
+    monkeypatch.setenv(TUNE_TABLE_ENV, str(path))
+    _, meta = resolve_kernel_params(**GEOM)  # geometry not in the table
+    assert meta["tuned"] is False
+    assert meta["table_source"] == "conservative"
+
+
+def test_explicit_params_beat_everything(tmp_path, monkeypatch):
+    key = geometry_key(4, 2, 16, None, 8)
+    path = tmp_path / "tuned.json"
+    save_tuning_table(str(path), "v5e", {key: {"kv_step": 4}})
+    monkeypatch.setenv(TUNE_TABLE_ENV, str(path))
+    params, meta = resolve_kernel_params(
+        **GEOM, params=KernelParams(kv_step=2, q_pack=1, scratch_width=64)
+    )
+    assert meta["table_source"] == "explicit"
+    assert (params.kv_step, params.q_pack, params.scratch_width) == (2, 1, 64)
+
+
+def test_kv_dtype_keys_separate_rows(tmp_path):
+    key8 = geometry_key(4, 2, 16, "int8", 8)
+    assert key8 == "4h2g16hs/int8/bs8"
+    path = tmp_path / "t.json"
+    save_tuning_table(str(path), None, {key8: {"kv_step": 4}})
+    p8, m8 = resolve_kernel_params(**GEOM, kv_dtype="int8",
+                                   table_path=str(path))
+    pf, mf = resolve_kernel_params(**GEOM, table_path=str(path))
+    assert m8["tuned"] and p8.kv_step == 4
+    assert not mf["tuned"] and pf.kv_step == 8  # fp row absent
+
+
+def test_bad_table_path_is_loud(tmp_path):
+    with pytest.raises(OSError):
+        resolve_kernel_params(
+            **GEOM, table_path=str(tmp_path / "missing.json")
+        )
+
+
+# ---------------------------------------------------------------------------
+# resolution helpers / validation / VMEM estimate
+# ---------------------------------------------------------------------------
+
+
+def test_default_q_pack_geometry_table():
+    assert default_q_pack(4, 32) == 4   # pythia-14m: 4*32 = 128 exactly
+    assert default_q_pack(4, 64) == 2   # tiny-llama: 2*64 = 128
+    assert default_q_pack(1, 64) == 1   # MQA cannot pack
+    assert default_q_pack(8, 128) == 1  # full lane already
+    assert default_q_pack(8, 16) == 8
+
+
+def test_validate_catches_each_problem():
+    ok = KernelParams(kv_step=8, q_pack=2, scratch_width=128)
+    assert validate_kernel_params(ok, 16, 4, 32) == []
+    bad_kv = validate_kernel_params(
+        KernelParams(kv_step=5, q_pack=1, scratch_width=128), 16, 4, 32
+    )
+    assert len(bad_kv) == 1 and "kv_step=5" in bad_kv[0]
+    bad_qp = validate_kernel_params(
+        KernelParams(kv_step=8, q_pack=3, scratch_width=128), 16, 4, 32
+    )
+    assert len(bad_qp) == 1 and "q_pack=3" in bad_qp[0]
+    bad_sw = validate_kernel_params(
+        KernelParams(kv_step=8, q_pack=1, scratch_width=0), 16, 4, 32
+    )
+    assert len(bad_sw) == 1 and "scratch_width=0" in bad_sw[0]
+
+
+def test_vmem_estimate_scales_with_knobs():
+    base = estimate_kernel_vmem(
+        4, 2, 16, 64, 8, KernelParams(kv_step=8, q_pack=2, scratch_width=128)
+    )
+    wider = estimate_kernel_vmem(
+        4, 2, 16, 64, 8, KernelParams(kv_step=8, q_pack=2, scratch_width=512)
+    )
+    assert wider > base  # scratch width is paid in VMEM
+    int8 = estimate_kernel_vmem(
+        4, 2, 16, 64, 8,
+        KernelParams(kv_step=8, q_pack=2, scratch_width=128),
+        kv_dtype="int8",
+    )
+    assert int8 < base  # 1-byte KV sub-blocks (scales cost less than payload)
+    assert base > 0
+
+
+def test_candidate_grid_shape():
+    cands = candidate_params(block_size=16, n_groups=4, head_size=32)
+    kv_steps = {c.kv_step for c in cands}
+    q_packs = {c.q_pack for c in cands}
+    assert kv_steps == {8, 16}          # divisors >= 8 (or the full block)
+    assert q_packs == {1, 2, 4}         # divisors of G fitting a lane tile
+    assert all(c.scratch_width == 128 for c in cands)
+
+
+# ---------------------------------------------------------------------------
+# artifact roundtrip
+# ---------------------------------------------------------------------------
+
+
+def test_save_load_roundtrip(tmp_path):
+    path = tmp_path / "table.json"
+    entries = {"4h2g16hs/fp/bs8": {"kv_step": 4, "q_pack": 2,
+                                   "scratch_width": 128}}
+    save_tuning_table(str(path), "v6e", entries,
+                      timings_us={"4h2g16hs/fp/bs8": [{"us": 1.0}]})
+    table = load_tuning_table(str(path))
+    assert table["device_kind"] == "v6e"
+    assert table["entries"] == entries
+    assert "timings_us" in table
+
+
+def test_load_bare_mapping(tmp_path):
+    path = tmp_path / "bare.json"
+    path.write_text(json.dumps({"*": {"kv_step": 8}}))
+    table = load_tuning_table(str(path))
+    assert table["entries"] == {"*": {"kv_step": 8}}
+    assert table["device_kind"] is None
+
+
+# ---------------------------------------------------------------------------
+# the sweep + CLI (CPU interpret: exercises every candidate, ranks nothing)
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_smoke_interpret():
+    best, results = autotune(
+        n_head=4, n_groups=2, head_size=8, block_size=8, max_blocks=2,
+        n_tokens=8, n_slots=2, reps=1,
+    )
+    assert len(results) == len(candidate_params(8, 2, 8))
+    assert best.to_dict() in [r["params"] for r in results]
+    assert all(r["us"] > 0 for r in results)
+
+
+def test_cli_writes_artifact_resolvable_by_serving(tmp_path, capsys):
+    out = tmp_path / "tuned.json"
+    rc = main([
+        "--n-head", "4", "--n-kv-heads", "2", "--head-size", "8",
+        "--block-size", "8", "--tokens", "8", "--slots", "2",
+        "--max-blocks", "2", "--reps", "1", "--out", str(out),
+    ])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "<-- best" in printed and "wrote" in printed
+    table = load_tuning_table(str(out))
+    key = geometry_key(4, 2, 8, None, 8)
+    assert key in table["entries"]
+    # the artifact feeds straight back into resolution as a user table
+    params, meta = resolve_kernel_params(
+        n_head=4, n_groups=2, head_size=8, block_size=8,
+        table_path=str(out),
+    )
+    assert meta["tuned"] and meta["table_source"] == f"file:{out}"
+    assert validate_kernel_params(params, 8, 2, 8) == []
+
+
+def test_cli_model_name_and_missing_geometry():
+    with pytest.raises(SystemExit):  # no model, incomplete geometry
+        main(["--n-head", "4"])
+
+
+def test_cli_help_covers_tuning_flags(capsys):
+    with pytest.raises(SystemExit):
+        main(["--help"])
+    help_text = capsys.readouterr().out
+    for flag in ("--model", "--n-head", "--n-kv-heads", "--head-size",
+                 "--block-size", "--kv-dtype", "--tokens", "--slots",
+                 "--reps", "--out", "--interpret"):
+        assert flag in help_text, f"{flag} missing from mdi-tune --help"
+    assert "MDI_TUNE_TABLE" in help_text
