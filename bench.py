@@ -681,6 +681,18 @@ def run_serve(args):
     from mdi_llm_tpu.obs import ServingObserver
 
     warm = build_engine(obs=ServingObserver(device=True))
+
+    # trace-level preflight (mdi-ir): compile-set closure, donation
+    # aliasing and IR hygiene over the EXACT executables this engine
+    # dispatches — side-band abstract traces, so the jit cache, donation
+    # behavior and CompileGuard counters of the real run are untouched
+    from mdi_llm_tpu.analysis.ir import (
+        enforce_ir_preflight, ir_detail, ir_preflight,
+    )
+
+    ir_report = ir_preflight(warm, origin=f"bench:{args.model}")
+    enforce_ir_preflight(ir_report, "bench", allow=args.no_preflight)
+
     for rid, prompt, new in trace:
         warm.add_request(
             rid, prompt, min(new, max(2, 2 * args.serve_chunk))
@@ -827,6 +839,7 @@ def run_serve(args):
             for name, summ in obs.latency_summaries().items()
         },
         "audit": audit,
+        "ir": ir_detail(ir_report),
         "baseline_tokens_per_s": base,
         "config": {
             "model": args.model, "slots": args.batch,
@@ -902,6 +915,13 @@ def run_serve_open(args):
     # set is identical and the sweep below runs zero post-warmup
     # recompiles (detail.compiles records it)
     warm = gen.serve(serving=serving_cfg, obs=ServingObserver(device=True))
+
+    from mdi_llm_tpu.analysis.ir import (
+        enforce_ir_preflight, ir_detail, ir_preflight,
+    )
+
+    ir_report = ir_preflight(warm, origin=f"bench:{args.model}")
+    enforce_ir_preflight(ir_report, "bench", allow=args.no_preflight)
     for rid, prompt, new in trace:
         warm.add_request(rid, prompt, min(new, max(2, 2 * args.serve_chunk)))
     warm.run()
@@ -1001,6 +1021,7 @@ def run_serve_open(args):
             "latency": head.get("latency"),
             "stats": head.get("stats"),
             "audit": audit,
+            "ir": ir_detail(ir_report),
             "device": device_block,
             "config": {
                 "model": args.model, "slots": args.batch,

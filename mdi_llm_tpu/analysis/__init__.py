@@ -35,10 +35,13 @@ __all__ = [
     # mdi-audit (lazy: keeps bare mdi-lint free of the jax import)
     "AUDIT_RULES", "AuditReport", "MeshSpec", "PlanSpec", "audit_plan",
     "preflight",
+    # mdi-ir (lazy for the same reason: tracing needs jax)
+    "IR_RULES", "IrReport", "ir_preflight", "trace_serving",
 ]
 
 _AUDIT_NAMES = {"AUDIT_RULES", "AuditReport", "audit_plan", "preflight"}
 _PLAN_NAMES = {"MeshSpec", "PlanSpec"}
+_IR_NAMES = {"IR_RULES", "IrReport", "ir_preflight", "trace_serving"}
 
 
 def __getattr__(name):
@@ -50,4 +53,8 @@ def __getattr__(name):
         from mdi_llm_tpu.analysis import plan
 
         return getattr(plan, name)
+    if name in _IR_NAMES:
+        from mdi_llm_tpu.analysis import ir
+
+        return getattr(ir, name)
     raise AttributeError(name)

@@ -1,5 +1,6 @@
 """`python -m mdi_llm_tpu.analysis` == `mdi-lint`;
-`python -m mdi_llm_tpu.analysis audit ...` == `mdi-audit`
+`python -m mdi_llm_tpu.analysis audit ...` == `mdi-audit`;
+`python -m mdi_llm_tpu.analysis ir ...` == `mdi-ir`
 (an explicit leading `lint` is also accepted)."""
 
 import sys
@@ -7,6 +8,10 @@ import sys
 argv = sys.argv[1:]
 if argv[:1] == ["audit"]:
     from mdi_llm_tpu.analysis.audit import main
+
+    raise SystemExit(main(argv[1:]))
+if argv[:1] == ["ir"]:
+    from mdi_llm_tpu.analysis.ir import main
 
     raise SystemExit(main(argv[1:]))
 if argv[:1] == ["lint"]:

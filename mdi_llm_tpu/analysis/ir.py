@@ -1,0 +1,859 @@
+"""`mdi-ir`: trace-level static analysis of the serving compile set.
+
+The fourth analysis family, below mdi-lint (source AST), mdi-audit
+(plan/shape arithmetic) and mdi-race (thread roles): abstractly trace —
+`jitted.trace(...)` / `.lower()` over `ShapeDtypeStruct`s, never
+`.compile()`, never a device — EVERY executable the serving engine can
+dispatch for a (Config, mesh, ServingConfig) tuple, and run an IR rule
+registry over each jaxpr.  The engine's headline guarantees (zero
+post-warmup recompiles, donated-pool aliasing) are otherwise enforced only
+dynamically (CompileGuard counters), so a shape that escapes the warmup
+set or a silently-dropped donation (JAX warns on stderr, then keeps BOTH
+pool copies) is invisible until a real run hits it.
+
+Executables come from the enumeration seams this tool motivated:
+`ServingEngine.enumerate_executables()` (the pipeline engine inherits it —
+its ring variants trace under the same labels/keys) and
+`Generator.enumerate_executables()` for the sequential `generate()` path,
+both built on `obs/device.py`'s side-band AOT machinery
+(`ExecutableSpec`, `abstractify`).  `trace_serving()` constructs the whole
+engine abstractly (`Generator(..., abstract=True)` over
+`analysis.plan.abstract_params` stubs), so the CLI needs no checkpoint, no
+backend, and no device — pinned by the same trip-wire test style as
+mdi-audit.
+
+Rules (IR_RULES):
+
+- **compile-set-closure** [error] — the enumerated warmup set must equal
+  the `step()`-reachable dispatch set derived independently from the
+  ServingConfig.  A reachable signature outside the enumeration is a
+  zero-recompile hole (first hit recompiles mid-serve); an enumerated
+  signature that is unreachable warms dead code.
+- **dropped-donation** [error] — every `donate_argnums` buffer must
+  surface in the lowered module's input-output aliasing
+  (`tf.aliasing_output`, or `jax.buffer_donor` when aliasing is deferred
+  to the SPMD partitioner under a mesh).  A donated-but-unaliased pool
+  keeps two copies live: a 2x HBM spike per dispatch.
+- **callback-in-executable** [error] — pure_callback / io_callback /
+  debug_callback (incl. `jax.debug.print`) inside a serving dispatch is a
+  host round-trip per step.
+- **sharding-constraint-drift** [error] — kv-pool sharding constraints
+  inside one executable must agree with the pool's declared sharding;
+  a drifted constraint makes GSPMD resharding-copy the whole pool every
+  step.
+- **dtype-promotion-leak** [warning] — a bf16/f16 operand upcast to f32
+  feeding a matmul on the compute path (weak-type promotion): 2x matmul
+  bytes for no accuracy contract.
+- **baked-constant-bloat** [warning] — a constant larger than
+  `--max-const-bytes` materialized inside the jaxpr ships inside the
+  executable (and re-uploads per compile); it belongs in an argument.
+- **trace-failure** [error] — an enumerated executable refused to trace
+  abstractly; whatever it does at runtime, the static contract is void.
+
+CLI: ``mdi-ir --model pythia-14m --tp 2`` (or ``python -m
+mdi_llm_tpu.analysis ir ...``); ``--format json``, ``--baseline`` /
+``--update-baseline`` (mdi-lint `Baseline` round-trip), ``--suppress
+RULE=justification`` (a justification is mandatory), ``--list-checks``.
+Exit 0 clean, 1 on findings, 2 on usage/plan errors.  Wired as a
+bench / mdi-serve preflight via `ir_preflight` + `enforce_ir_preflight`
+(`detail.ir` per serve row).  See docs/analysis.md, "Trace-level
+analysis (mdi-ir)".
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import warnings
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from mdi_llm_tpu.analysis.core import Baseline, Finding
+from mdi_llm_tpu.config import Config, ServingConfig
+
+__all__ = [
+    "IR_RULES",
+    "IrReport",
+    "analyze_executables",
+    "enforce_ir_preflight",
+    "ir_detail",
+    "ir_preflight",
+    "main",
+    "reachable_serving_set",
+    "trace_serving",
+]
+
+ERROR, WARNING = "error", "warning"
+
+# rule -> (severity, one-line summary); --list-checks prints this
+IR_RULES: Dict[str, Tuple[str, str]] = {
+    "compile-set-closure": (ERROR, (
+        "enumerated warmup set != the step()-reachable dispatch set: a "
+        "reachable shape outside the enumeration is a zero-recompile hole, "
+        "an unreachable enumerated shape warms dead code"
+    )),
+    "dropped-donation": (ERROR, (
+        "a donate_argnums buffer is missing from the lowered input-output "
+        "aliasing (tf.aliasing_output / jax.buffer_donor): JAX keeps both "
+        "copies live — a 2x pool HBM spike per dispatch"
+    )),
+    "callback-in-executable": (ERROR, (
+        "pure_callback/io_callback/debug_callback embedded in a serving "
+        "dispatch: a host round-trip per step"
+    )),
+    "sharding-constraint-drift": (ERROR, (
+        "a kv-pool sharding constraint inside the executable disagrees "
+        "with the pool's declared sharding: GSPMD resharding-copies the "
+        "pool every step"
+    )),
+    "dtype-promotion-leak": (WARNING, (
+        "a low-precision operand is upcast to f32 feeding a matmul on the "
+        "compute path (weak-type promotion): 2x matmul bytes"
+    )),
+    "baked-constant-bloat": (WARNING, (
+        "a large constant is materialized inside the jaxpr: it ships "
+        "inside the executable instead of riding as an argument"
+    )),
+    "trace-failure": (ERROR, (
+        "an enumerated executable refused to trace abstractly — the "
+        "static compile-set contract cannot be checked"
+    )),
+}
+
+DEFAULT_MAX_CONST_BYTES = 8 * 1024 * 1024  # rope tables for small/medium
+# models sit well under this; a baked PARAM leaf blows straight through it
+
+_LOW_PRECISION = ("bfloat16", "float16")
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def _iter_jaxprs(closed) -> Iterator[Tuple[Any, Sequence[Any]]]:
+    """Yield (jaxpr, consts) for a ClosedJaxpr and every jaxpr nested in
+    its equations' params (pjit bodies, scan/while/cond branches,
+    shard_map regions, custom_jvp calls, ...).  Duck-typed — any param
+    value with `.eqns` is a Jaxpr, any with `.jaxpr` a ClosedJaxpr — so
+    no jax-internal imports and no version pinning."""
+    seen: Set[int] = set()
+
+    def rec(jaxpr, consts):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        yield jaxpr, consts
+        for eqn in jaxpr.eqns:
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (list, tuple)) else (val,)
+                for v in vals:
+                    inner = getattr(v, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        yield from rec(inner, getattr(v, "consts", ()))
+                    elif hasattr(v, "eqns"):
+                        yield from rec(v, ())
+
+    top = getattr(closed, "jaxpr", closed)
+    yield from rec(top, getattr(closed, "consts", ()))
+
+
+def _count_eqns(closed) -> int:
+    return sum(len(j.eqns) for j, _ in _iter_jaxprs(closed))
+
+
+def _aval_nbytes(x) -> int:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+def _dtype_name(x) -> str:
+    try:
+        return np.dtype(getattr(x, "dtype", x)).name
+    except TypeError:
+        return str(getattr(x, "dtype", x))
+
+
+# ---------------------------------------------------------------------------
+# per-executable rules
+# ---------------------------------------------------------------------------
+
+
+def _check_callbacks(spec, closed, path: str) -> List[Finding]:
+    hits: Dict[str, int] = {}
+    for jaxpr, _ in _iter_jaxprs(closed):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if any(c in name for c in _CALLBACK_PRIMS):
+                hits[name] = hits.get(name, 0) + 1
+    return [
+        Finding(
+            rule="callback-in-executable", path=path, line=0, col=0,
+            message=(
+                f"{spec.name} embeds {n}x `{prim}`: every dispatch makes a "
+                "host round-trip (drop jax.debug.print / callbacks from the "
+                "serving path, or move them behind an off-by-default flag)"
+            ),
+            line_text=f"callback:{prim}",
+        )
+        for prim, n in sorted(hits.items())
+    ]
+
+
+def _check_const_bloat(spec, closed, path: str, max_bytes: int) -> List[Finding]:
+    out: List[Finding] = []
+    for jaxpr, consts in _iter_jaxprs(closed):
+        for c in consts:
+            nb = _aval_nbytes(c)
+            if nb >= max_bytes:
+                out.append(Finding(
+                    rule="baked-constant-bloat", path=path, line=0, col=0,
+                    message=(
+                        f"{spec.name} bakes a {nb / 2**20:.1f} MiB "
+                        f"{_dtype_name(c)}{tuple(np.shape(c))} constant into "
+                        f"the jaxpr (threshold {max_bytes / 2**20:.0f} MiB): "
+                        "it ships inside the executable — pass it as an "
+                        "argument instead"
+                    ),
+                    line_text=(
+                        f"const:{_dtype_name(c)}:{tuple(np.shape(c))}"
+                    ),
+                ))
+    return out
+
+
+def _check_dtype_leaks(spec, closed, path: str) -> List[Finding]:
+    """convert(low-precision -> f32) feeding a dot_general operand: the
+    matmul runs at 2x the bytes the compute dtype promises.  Narrow by
+    construction — only DIRECT convert->dot edges flag, so f32 softmax
+    statistics, sampling logits upcasts etc. never false-positive."""
+    hits: Dict[str, int] = {}
+    for jaxpr, _ in _iter_jaxprs(closed):
+        defn: Dict[Any, Any] = {}
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                defn[ov] = eqn
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name not in ("dot_general", "conv_general_dilated"):
+                continue
+            for iv in eqn.invars:
+                src = defn.get(iv)
+                if src is None or src.primitive.name != "convert_element_type":
+                    continue
+                src_in = src.invars[0]
+                in_dt = _dtype_name(getattr(src_in, "aval", src_in))
+                out_dt = _dtype_name(getattr(iv, "aval", iv))
+                if out_dt == "float32" and in_dt in _LOW_PRECISION:
+                    hits[in_dt] = hits.get(in_dt, 0) + 1
+    return [
+        Finding(
+            rule="dtype-promotion-leak", path=path, line=0, col=0,
+            message=(
+                f"{spec.name} upcasts {n}x {dt}->f32 directly feeding a "
+                "matmul: the contraction runs at 2x the compute-path bytes "
+                "(keep operands in the compute dtype; accumulate via "
+                "preferred_element_type if f32 accumulation is the intent)"
+            ),
+            line_text=f"leak:{dt}",
+        )
+        for dt, n in sorted(hits.items())
+    ]
+
+
+def _constraint_spec_str(sharding) -> Optional[str]:
+    spec = getattr(sharding, "spec", None)
+    return None if spec is None else str(spec)
+
+
+def _check_sharding_drift(spec, closed, path: str) -> List[Finding]:
+    """Compare every `sharding_constraint` whose operand rank matches a kv
+    pool leaf against the pool's DECLARED sharding (the kv
+    ShapeDtypeStructs in `spec.args` carry it).  Constraints on other
+    ranks (activations etc.) are out of scope; unmeshed engines have no
+    declared shardings and skip."""
+    import jax
+
+    expected: Dict[int, Set[str]] = {}  # rank -> declared spec strings
+    for i in spec.donate:
+        for leaf in jax.tree_util.tree_leaves(spec.args[i]):
+            sh = getattr(leaf, "sharding", None)
+            s = _constraint_spec_str(sh) if sh is not None else None
+            if s is not None:
+                expected.setdefault(len(leaf.shape), set()).add(s)
+    if not expected:
+        return []
+    out: List[Finding] = []
+    seen_mismatch: Set[Tuple[int, str]] = set()
+    for jaxpr, _ in _iter_jaxprs(closed):
+        for eqn in jaxpr.eqns:
+            if "sharding_constraint" not in eqn.primitive.name:
+                continue
+            sh = eqn.params.get("sharding")
+            s = _constraint_spec_str(sh)
+            if s is None:
+                continue  # opaque (GSPMD) constraint: nothing to compare
+            rank = len(getattr(eqn.invars[0].aval, "shape", ()))
+            declared = expected.get(rank)
+            if declared is None or s in declared:
+                continue
+            key = (rank, s)
+            if key in seen_mismatch:
+                continue
+            seen_mismatch.add(key)
+            out.append(Finding(
+                rule="sharding-constraint-drift", path=path, line=0, col=0,
+                message=(
+                    f"{spec.name} pins a rank-{rank} kv-pool value to "
+                    f"{s}, but the pool is declared "
+                    f"{sorted(declared)}: GSPMD inserts a resharding copy "
+                    "of the pool on every dispatch (make _pin_kv and the "
+                    "pool placement agree)"
+                ),
+                line_text=f"drift:rank{rank}:{s}",
+            ))
+    return out
+
+
+def _check_donation(spec, traced, path: str) -> List[Finding]:
+    """Lower (never compile) and count aliased/donor-marked inputs against
+    the donated leaf count.  Single-device modules carry the final
+    `tf.aliasing_output` attributes; under a mesh aliasing is decided by
+    the SPMD partitioner, so the pre-compile module marks donors with
+    `jax.buffer_donor` instead — both count.  JAX's own lower-time
+    'donated buffers were not usable' warning is captured and quoted."""
+    import jax
+
+    expected = sum(
+        len(jax.tree_util.tree_leaves(spec.args[i])) for i in spec.donate
+    )
+    if not expected:
+        return []
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        try:
+            text = traced.lower().as_text()
+        except Exception as e:  # lowering is rule input, not a crash site
+            return [Finding(
+                rule="trace-failure", path=path, line=0, col=0,
+                message=f"{spec.name} failed to lower abstractly: {e}",
+                line_text="lower",
+            )]
+    marked = text.count("tf.aliasing_output") + text.count("jax.buffer_donor")
+    if marked >= expected:
+        return []
+    dropped = [
+        str(w.message) for w in caught
+        if "donated buffers were not usable" in str(w.message)
+    ]
+    why = f" (JAX: {dropped[0]})" if dropped else ""
+    return [Finding(
+        rule="dropped-donation", path=path, line=0, col=0,
+        message=(
+            f"{spec.name} donates {expected} buffer(s) via donate_argnums="
+            f"{tuple(spec.donate)} but only {marked} are aliased/marked in "
+            "the lowered module: the un-aliased donations keep BOTH copies "
+            "live — a 2x HBM spike per dispatch (every donated input needs "
+            f"a shape/dtype-matched output){why}"
+        ),
+        line_text=f"donation:{expected - marked}",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# compile-set closure
+# ---------------------------------------------------------------------------
+
+
+def reachable_serving_set(
+    serving: ServingConfig, max_batch: int, token_budget: int
+) -> Set[Tuple[str, Tuple[int, ...]]]:
+    """The dispatch signatures `ServingEngine.step()` can reach, derived
+    INDEPENDENTLY from the ServingConfig semantics (engine.py step
+    routing): mixed always; verify iff spec_k (spec decode falls through
+    to plain decode when no slot drafts, so decode stays reachable);
+    decode_chunk iff decode_chunk > 1, else decode.  Deliberately a
+    second implementation — diffing it against the engine's own
+    enumeration is the closure proof."""
+    sigs: Set[Tuple[str, Tuple[int, ...]]] = {
+        ("mixed", (int(max_batch), int(token_budget)))
+    }
+    if serving.spec_k:
+        sigs.add(("verify", (int(max_batch), int(serving.spec_k) + 1)))
+    if serving.decode_chunk > 1:
+        sigs.add(("decode_chunk", (int(max_batch), int(serving.decode_chunk))))
+    else:
+        sigs.add(("decode", (int(max_batch),)))
+    return sigs
+
+
+def _check_compile_set(engine, specs, origin: str) -> List[Finding]:
+    path = f"{origin}::compile-set"
+    enumerated = {(s.label, tuple(s.key)) for s in specs}
+    reachable = reachable_serving_set(
+        engine.cfg, engine.scheduler.max_batch, engine.token_budget
+    )
+    out: List[Finding] = []
+    for label, key in sorted(reachable - enumerated):
+        out.append(Finding(
+            rule="compile-set-closure", path=path, line=0, col=0,
+            message=(
+                f"step() can dispatch {label}{key} but the engine does not "
+                "enumerate it: the first hit compiles MID-SERVE — a "
+                "zero-recompile hole (fix enumerate_executables/"
+                "reachable_signatures to cover every step() branch)"
+            ),
+            line_text=f"missing:{label}{key}",
+        ))
+    for label, key in sorted(enumerated - reachable):
+        out.append(Finding(
+            rule="compile-set-closure", path=path, line=0, col=0,
+            message=(
+                f"the engine enumerates {label}{key} but no step() branch "
+                "can reach it under this ServingConfig: dead warmup "
+                "(compile time + HBM for an executable that never runs)"
+            ),
+            line_text=f"unreachable:{label}{key}",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def analyze_executables(
+    specs: Sequence[Any],
+    origin: str = "<specs>",
+    compute_dtype: Optional[str] = None,
+    max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
+    check_donation: bool = True,
+) -> Tuple[List[Finding], List[Dict[str, Any]]]:
+    """Trace every `ExecutableSpec` and run the per-executable rules.
+    Returns (findings, executable records).  `compute_dtype` gates the
+    dtype-promotion-leak rule: it only means anything when the params
+    are low-precision."""
+    findings: List[Finding] = []
+    records: List[Dict[str, Any]] = []
+    leak_rule = compute_dtype is not None and (
+        np.dtype(compute_dtype).name in _LOW_PRECISION
+    )
+    for spec in specs:
+        path = f"{origin}::{spec.name}"
+        try:
+            traced = spec.fn.trace(*spec.args, **(spec.static_kwargs or {}))
+            closed = traced.jaxpr
+        except Exception as e:
+            findings.append(Finding(
+                rule="trace-failure", path=path, line=0, col=0,
+                message=f"{spec.name} failed to trace abstractly: {e}",
+                line_text="trace",
+            ))
+            records.append({"name": spec.name, "label": spec.label,
+                            "key": list(spec.key), "error": str(e)})
+            continue
+        found_here: List[Finding] = []
+        found_here += _check_callbacks(spec, closed, path)
+        found_here += _check_const_bloat(spec, closed, path, max_const_bytes)
+        if leak_rule:
+            found_here += _check_dtype_leaks(spec, closed, path)
+        found_here += _check_sharding_drift(spec, closed, path)
+        if check_donation and spec.donate:
+            found_here += _check_donation(spec, traced, path)
+        findings.extend(found_here)
+        records.append({
+            "name": spec.name, "label": spec.label, "key": list(spec.key),
+            "eqns": _count_eqns(closed),
+            "donated": sum(
+                len(_tree_leaves(spec.args[i])) for i in spec.donate
+            ),
+            "findings": len(found_here),
+        })
+    return findings, records
+
+
+def _tree_leaves(x):
+    import jax
+
+    return jax.tree_util.tree_leaves(x)
+
+
+@dataclasses.dataclass
+class IrReport:
+    """One mdi-ir pass: findings + the traced executable inventory."""
+
+    origin: str
+    findings: List[Finding]
+    executables: List[Dict[str, Any]]
+    suppressed: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    def severity(self, f: Finding) -> str:
+        return IR_RULES.get(f.rule, (ERROR, ""))[0]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if self.severity(f) == ERROR]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if self.severity(f) == WARNING]
+
+    def suppress(self, reasons: Dict[str, str]) -> None:
+        """Move findings whose rule has a justified suppression out of the
+        active set (they still print, marked suppressed, and ride the JSON
+        output with their justification)."""
+        keep: List[Finding] = []
+        for f in self.findings:
+            reason = reasons.get(f.rule)
+            if reason:
+                self.suppressed.append({
+                    "rule": f.rule, "path": f.path, "message": f.message,
+                    "justification": reason,
+                })
+            else:
+                keep.append(f)
+        self.findings = keep
+
+    def render_findings(self) -> List[str]:
+        return [
+            f"{f.path}: {self.severity(f)}: {f.rule}: {f.message}"
+            for f in self.findings
+        ]
+
+    def render_text(self) -> str:
+        lines = [f"traced: {self.origin}"]
+        for r in self.executables:
+            if "error" in r:
+                lines.append(f"  {r['name']:<24} TRACE FAILED: {r['error']}")
+            else:
+                lines.append(
+                    f"  {r['name']:<24} eqns={r['eqns']:<6} "
+                    f"donated={r['donated']}"
+                )
+        if self.findings:
+            lines.extend(self.render_findings())
+        else:
+            lines.append("findings: none")
+        for s in self.suppressed:
+            lines.append(
+                f"suppressed: {s['rule']} ({s['justification']}): "
+                f"{s['message']}"
+            )
+        return "\n".join(lines)
+
+    def as_json(self) -> Dict[str, Any]:
+        return {
+            "origin": self.origin,
+            "executables": self.executables,
+            "findings": [
+                {**f.__dict__, "severity": self.severity(f)}
+                for f in self.findings
+            ],
+            "suppressed": self.suppressed,
+            "errors": len(self.errors),
+            "warnings": len(self.warnings),
+        }
+
+
+def ir_preflight(
+    engine,
+    origin: Optional[str] = None,
+    max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
+    check_donation: bool = True,
+) -> IrReport:
+    """Run the full rule set over one serving engine — abstract
+    (`trace_serving`) or live (bench/mdi-serve: `abstractify` strips the
+    real buffers; `.trace`/`.lower` are side-band, so the jit cache,
+    donation behavior and CompileGuard counters of the real dispatches
+    are untouched)."""
+    from mdi_llm_tpu.models import transformer
+
+    origin = origin or type(engine).__name__
+    specs = engine.enumerate_executables()
+    findings = _check_compile_set(engine, specs, origin)
+    try:
+        compute_dtype = np.dtype(
+            transformer.param_dtype(engine.gen.params)
+        ).name
+    except (TypeError, ValueError):
+        compute_dtype = None
+    per_exec, records = analyze_executables(
+        specs,
+        origin=origin,
+        compute_dtype=compute_dtype,
+        max_const_bytes=max_const_bytes,
+        check_donation=check_donation,
+    )
+    findings += per_exec
+    return IrReport(origin=origin, findings=findings, executables=records)
+
+
+def trace_serving(
+    cfg: Config,
+    serving: Optional[ServingConfig] = None,
+    tp: int = 1,
+    pp: int = 1,
+    dtype: str = "bfloat16",
+    quantize: Optional[str] = None,
+    max_seq_length: Optional[int] = None,
+    scan_unroll: int = 1,
+):
+    """Build the ENTIRE serving engine abstractly for a (Config, mesh,
+    ServingConfig) tuple: zero-stride param stubs
+    (`analysis.plan.abstract_params`), `Generator(abstract=True)` (no
+    device_put, no PRNG seed compile), and a ShapeDtypeStruct kv pool —
+    then `.serve()` routes to the flat or pipelined engine exactly like a
+    real launch.  Returns the engine; run `ir_preflight` on it.  Requires
+    only that jax can ENUMERATE tp*pp devices for the mesh (CI forces 8
+    host-platform devices); nothing is compiled or placed."""
+    from mdi_llm_tpu.analysis.plan import abstract_params
+    from mdi_llm_tpu.generation import Generator
+
+    serving = serving if serving is not None else ServingConfig()
+    mesh = None
+    axes: Dict[str, int] = {}
+    if int(pp) > 1:
+        axes["pp"] = int(pp)
+    if int(tp) > 1:
+        axes["tp"] = int(tp)
+    if axes:
+        from mdi_llm_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(axes)
+    params = abstract_params(cfg, dtype=dtype, quantize=quantize)
+    gen = Generator(
+        cfg,
+        params,
+        max_seq_length=max_seq_length,
+        mesh=mesh,
+        scan_unroll=scan_unroll,
+        abstract=True,
+    )
+    return gen.serve(serving=serving)
+
+
+# ---------------------------------------------------------------------------
+# launch gate (bench.py / mdi-serve)
+# ---------------------------------------------------------------------------
+
+
+def ir_refusal_text(tool: str) -> str:
+    return (f"{tool}: mdi-ir preflight refused the launch "
+            "(re-run with --no-preflight to launch anyway)")
+
+
+def enforce_ir_preflight(
+    report: IrReport, tool: str, allow: bool = False, emit=None
+) -> bool:
+    """Mirror of mdi-audit's `enforce_preflight` for the trace-level pass:
+    emit every finding, refuse on errors unless `allow`
+    (--no-preflight)."""
+    if emit is None:
+        def emit(line):
+            print(line, file=sys.stderr)
+    for line in report.render_findings():
+        emit(f"{tool}: ir-preflight: {line}")
+    if not report.errors or allow:
+        return True
+    raise SystemExit(ir_refusal_text(tool))
+
+
+def ir_detail(report: IrReport) -> Dict[str, Any]:
+    """The compact per-row record bench.py stores under `detail.ir`."""
+    return {
+        "findings": len(report.errors),
+        "warnings": len(report.warnings),
+        "executables": {
+            r["name"]: r.get("eqns") for r in report.executables
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mdi-ir",
+        description="Trace-level static analysis: abstractly trace every "
+        "serving executable for a (model, mesh, ServingConfig) tuple — no "
+        "checkpoint, no device, no compile — and verify compile-set "
+        "closure, donation aliasing, and IR hygiene (see docs/analysis.md, "
+        "'Trace-level analysis (mdi-ir)')",
+    )
+    src = ap.add_argument_group("model source")
+    src.add_argument("--model", default=None, help="registry model name")
+    src.add_argument("--config", default=None, metavar="FILE",
+                     help="model_config.yaml / config.json to trace")
+    par = ap.add_argument_group("parallel plan")
+    par.add_argument("--tp", type=int, default=1,
+                     help="tensor-parallel mesh axis (abstract devices)")
+    par.add_argument("--pp", type=int, default=1,
+                     help="pipeline-parallel serving stages (>=2 routes to "
+                     "PipelinedServingEngine, exactly like a real launch)")
+    run = ap.add_argument_group("run shape")
+    run.add_argument("--seq-len", type=int, default=None,
+                     help="engine window (default: model context)")
+    run.add_argument("--dtype", default="bfloat16",
+                     choices=("bfloat16", "float16", "float32"))
+    run.add_argument("--quantize", default="none",
+                     choices=("none", "int8", "w8a8"))
+    srv = ap.add_argument_group("serving (ServingConfig)")
+    srv.add_argument("--block-size", type=int, default=16)
+    srv.add_argument("--max-batch", type=int, default=8)
+    srv.add_argument("--prefill-chunk", type=int, default=128)
+    srv.add_argument("--token-budget", type=int, default=None)
+    srv.add_argument("--decode-chunk", type=int, default=8)
+    srv.add_argument("--spec-k", type=int, default=0)
+    srv.add_argument("--temperature", type=float, default=0.0)
+    srv.add_argument("--top-k", type=int, default=None)
+    srv.add_argument("--top-p", type=float, default=None)
+    srv.add_argument("--kv-dtype", default="auto",
+                     help="paged-pool storage dtype (e.g. int8)")
+    seq = ap.add_argument_group("sequential generate() path")
+    seq.add_argument("--sequential", action="store_true",
+                     help="also trace the generate() compile set for the "
+                     "workload below")
+    seq.add_argument("--batch", type=int, default=1)
+    seq.add_argument("--prompt-len", type=int, default=32)
+    seq.add_argument("--new-tokens", type=int, default=32)
+    seq.add_argument("--chunk-size", type=int, default=16)
+    seq.add_argument("--speculative", type=int, default=None)
+    ap.add_argument("--max-const-bytes", type=int,
+                    default=DEFAULT_MAX_CONST_BYTES,
+                    help="baked-constant-bloat threshold (bytes)")
+    ap.add_argument("--no-donation-check", action="store_true",
+                    help="skip the .lower()-based dropped-donation rule "
+                    "(the slowest rule on big models)")
+    ap.add_argument("--suppress", action="append", default=[],
+                    metavar="RULE=WHY",
+                    help="suppress a rule WITH a justification (mandatory); "
+                    "repeatable")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="grandfather findings via an mdi-lint-style "
+                    "baseline")
+    ap.add_argument("--update-baseline", default=None, metavar="FILE",
+                    help="write the current findings as the baseline and "
+                    "exit 0")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print the IR rule registry and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checks:
+        width = max(len(c) for c in IR_RULES)
+        for code, (sev, summary) in IR_RULES.items():
+            print(f"{code:<{width}}  [{sev}] {summary}")
+        return 0
+    reasons: Dict[str, str] = {}
+    for s in args.suppress:
+        rule, _, why = s.partition("=")
+        rule, why = rule.strip(), why.strip()
+        if rule not in IR_RULES:
+            print(f"mdi-ir: unknown rule in --suppress: {rule!r}",
+                  file=sys.stderr)
+            return 2
+        if not why:
+            print("mdi-ir: --suppress requires a justification: "
+                  f"{rule}=<why this is acceptable>", file=sys.stderr)
+            return 2
+        reasons[rule] = why
+    try:
+        if args.config:
+            cfg = Config.from_file(args.config)
+        elif args.model:
+            cfg = Config.from_name(args.model)
+        else:
+            raise ValueError("need --model or --config")
+        serving = ServingConfig(
+            block_size=args.block_size,
+            max_batch=args.max_batch,
+            prefill_chunk=args.prefill_chunk,
+            token_budget=args.token_budget,
+            decode_chunk=args.decode_chunk,
+            spec_k=args.spec_k,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
+        )
+        engine = trace_serving(
+            cfg,
+            serving,
+            tp=args.tp,
+            pp=args.pp,
+            dtype=args.dtype,
+            quantize=None if args.quantize == "none" else args.quantize,
+            max_seq_length=args.seq_len,
+        )
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"mdi-ir: {e}", file=sys.stderr)
+        return 2
+    name = args.model or Path(args.config).stem
+    mesh_tag = "".join(
+        t for t in (f"@tp{args.tp}" if args.tp > 1 else "",
+                    f"@pp{args.pp}" if args.pp > 1 else "")
+    )
+    report = ir_preflight(
+        engine,
+        origin=f"{name}{mesh_tag}",
+        max_const_bytes=args.max_const_bytes,
+        check_donation=not args.no_donation_check,
+    )
+    if args.sequential:
+        try:
+            seq_specs = engine.gen.enumerate_executables(
+                batch_size=args.batch,
+                prompt_len=args.prompt_len,
+                max_new_tokens=args.new_tokens,
+                chunk_size=args.chunk_size,
+                speculative=args.speculative,
+            )
+        except ValueError as e:
+            print(f"mdi-ir: {e}", file=sys.stderr)
+            return 2
+        f2, r2 = analyze_executables(
+            seq_specs,
+            origin=f"{name}{mesh_tag}:generate",
+            compute_dtype=args.dtype,
+            max_const_bytes=args.max_const_bytes,
+            check_donation=not args.no_donation_check,
+        )
+        report.findings += f2
+        report.executables += r2
+    report.suppress(reasons)
+    if args.update_baseline:
+        Baseline.from_findings(report.findings).save(
+            Path(args.update_baseline)
+        )
+        print(f"mdi-ir: wrote {len(report.findings)} finding(s) to "
+              f"{args.update_baseline}")
+        return 0
+    errors = report.errors
+    if args.baseline:
+        new, _old = Baseline.load(Path(args.baseline)).split(errors)
+        errors = new
+    if args.format == "json":
+        out = report.as_json()
+        out["new_errors"] = len(errors)
+        print(json.dumps(out, indent=2))
+    else:
+        print(report.render_text())
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

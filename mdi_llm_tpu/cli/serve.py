@@ -319,6 +319,13 @@ def main(argv=None):
     engine = gen.serve(serving=serving_cfg, obs=obs,
                        policy=make_policy(args.policy))
 
+    # trace-level preflight: verify the compile set and the IR invariants
+    # on abstract jaxprs of THIS engine's executables (docs/analysis.md)
+    from mdi_llm_tpu.analysis.ir import enforce_ir_preflight, ir_preflight
+
+    ir_report = ir_preflight(engine, origin="mdi-serve")
+    enforce_ir_preflight(ir_report, "mdi-serve", allow=args.no_preflight)
+
     if args.synthetic:
         trace = synthetic_trace(
             args.synthetic, cfg.vocab_size, gen.max_seq_length, args.n_tokens

@@ -296,9 +296,15 @@ class Generator:
         # small, so XLA while-loop bookkeeping per layer is measurable;
         # unrolling trades compile time for loop overhead (bench
         # --scan-unroll to measure before changing the default)
+        abstract: bool = False,  # trace-only construction (analysis/ir.py):
+        # params stay a host-side stub tree (plan.abstract_params), nothing
+        # is placed on a device, and the PRNG key is a ShapeDtypeStruct.
+        # The resulting Generator/engine can build and abstractly trace
+        # every executable but must never be dispatched
     ):
         self.cfg = cfg
         self.mesh = mesh
+        self.abstract = bool(abstract)
         self._kv_sharding = None
         self._paged_kv_sharding = None
         self._paged_kv_scale_sharding = None
@@ -330,7 +336,7 @@ class Generator:
             # or every jit call re-uploads the whole model (under a mesh the
             # sharded placement below does the pinning)
             params = quantize_params(params, mode=FLAG_TO_MODE[quantize])
-            if mesh is None:
+            if mesh is None and not abstract:
                 params = jax.device_put(params)
         if mesh is not None:
             from mdi_llm_tpu.parallel.sharding import (
@@ -354,7 +360,12 @@ class Generator:
                     axis="ep",
                     capacity_factor=moe_capacity_factor,
                 )
-            if quantized and ep_moe:
+            if abstract:
+                # trace-only: the divisibility validation above still ran,
+                # but the stub tree stays host-side (shardings reach the
+                # traces through the kv pool/operand ShapeDtypeStructs)
+                pass
+            elif quantized and ep_moe:
                 # name-agnostic placement: leaves under an "experts" subtree
                 # shard their (layer, expert, ...) expert axis over ep (this
                 # covers weight_q/scale layouts too); all else replicates
@@ -403,7 +414,12 @@ class Generator:
         self.cache_dtype = cache_dtype
         self.scan_unroll = int(scan_unroll)
         self.rope = transformer.get_rope_cache(cfg)
-        self.key = jax.random.PRNGKey(rng_seed)
+        if abstract:
+            # shape/dtype of jax.random.PRNGKey(seed) without compiling the
+            # threefry seed program (mdi-ir's zero-backend contract)
+            self.key = jax.ShapeDtypeStruct((2,), np.uint32)
+        else:
+            self.key = jax.random.PRNGKey(rng_seed)
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._decode_fns: Dict[int, Any] = {}
         self._decode_chunk_fns: Dict[Tuple[int, int], Any] = {}
@@ -589,6 +605,130 @@ class Generator:
 
             self._decode_chunk_fns[key_] = verify
         return self._decode_chunk_fns[key_]
+
+    # -- static enumeration (analysis/ir.py) ---------------------------------
+
+    def enumerate_executables(
+        self,
+        batch_size: int = 1,
+        prompt_len: int = 32,
+        max_new_tokens: int = 32,
+        chunk_size: int = 16,
+        temperature: float = TEMPERATURE,
+        top_k: Optional[int] = TOP_K,
+        top_p: Optional[float] = None,
+        speculative: Optional[int] = None,
+        compact: bool = True,
+    ) -> List[Any]:
+        """The sequential `generate()` compile set for ONE workload shape,
+        as abstract `ExecutableSpec`s: prefill at the prompt's pow2 bucket,
+        the decode-chunk ladder (the full chunk width, the tail chunk, and
+        every lane count batch compaction can gather down to), and the
+        speculative verify forward when `speculative=K`.
+
+        Unlike the serving engine's set (closed by construction — the
+        zero-recompile contract), `generate()` retraces per workload shape
+        BY DESIGN (prompt buckets, 256-granular cache lengths), so this is
+        the nominal set for one (B, prompt_len, max_new_tokens) workload,
+        for mdi-ir jaxpr inspection rather than closure proofs.  The
+        shared-prefill broadcast variant (prompt-content dependent) and
+        cache-pressure-clamped tail widths share these traced structures
+        at other shapes and are not enumerated."""
+        from mdi_llm_tpu.obs.device import ExecutableSpec, abstractify
+
+        B = int(batch_size)
+        if B < 1 or prompt_len < 1 or max_new_tokens < 1:
+            raise ValueError("batch_size, prompt_len and max_new_tokens must be >= 1")
+        total_max = prompt_len + max_new_tokens
+        if total_max > self.max_seq_length:
+            raise ValueError(
+                f"prompt+generation length {total_max} exceeds max_seq_length "
+                f"{self.max_seq_length}"
+            )
+        Tb = min(_bucket(prompt_len), self.max_seq_length)
+        cache_len = _run_cache_len(self.max_seq_length, total_max, Tb)
+        i32 = jnp.int32
+        sds = jax.ShapeDtypeStruct
+        params = abstractify(self.params)
+        key = abstractify(self.key)
+
+        def kv_abs(nb):
+            t = jax.eval_shape(
+                partial(
+                    transformer.init_kv_cache,
+                    self.cfg,
+                    nb,
+                    cache_len,
+                    dtype=self.cache_dtype,
+                )
+            )
+            if self._kv_sharding is not None:
+                t = jax.tree_util.tree_map(
+                    lambda l: sds(l.shape, l.dtype, sharding=self._kv_sharding),
+                    t,
+                )
+            return t
+
+        specs = [
+            ExecutableSpec(
+                "prefill",
+                (B, Tb),
+                self._prefill_fn(B, Tb),
+                (params, sds((B, Tb), i32), kv_abs(B), sds((B,), i32)),
+                None,
+                (2,),
+            )
+        ]
+        statics = {"mode": sample_mode(temperature, top_k, top_p), "top_k": top_k}
+        t_op = sds((), jnp.float32)
+        p_op = sds((), jnp.float32)
+        # decode-chunk widths the host loop dispatches: n starts at 1 (the
+        # prefill-sampled token), so the full width is min(chunk_size,
+        # max_new_tokens - 1) and the remainder rides in one tail chunk
+        k_full = min(int(chunk_size), max_new_tokens - 1)
+        widths = []
+        if k_full >= 1:
+            widths.append(k_full)
+            tail = (max_new_tokens - 1) % k_full
+            if tail and tail != k_full:
+                widths.append(tail)
+        # batch-compaction lane ladder: compaction gathers survivors into the
+        # next pow2 bucket >= the live count, floored at min(4, B) and only
+        # when the bucket is <= half the current lane count — so the
+        # reachable lane counts are B plus every pow2 in [min(4, B), B // 2]
+        lane_counts = {B}
+        if compact and self.mesh is None:
+            v = 1
+            while v <= B // 2:
+                if v >= min(4, B):
+                    lane_counts.add(v)
+                v *= 2
+        for nb in sorted(lane_counts, reverse=True):
+            kvn = kv_abs(nb)
+            for w in widths:
+                specs.append(
+                    ExecutableSpec(
+                        "decode_chunk",
+                        (nb, w),
+                        self._decode_chunk_fn(nb, w),
+                        (params, sds((nb,), i32), kvn, sds((nb,), i32), key, t_op, p_op),
+                        dict(statics),
+                        (2,),
+                    )
+                )
+        if speculative:
+            K = int(speculative)
+            specs.append(
+                ExecutableSpec(
+                    "verify",
+                    (K + 1,),
+                    self._verify_fn(K + 1),
+                    (params, sds((1, K + 1), i32), kv_abs(1), sds((1,), i32)),
+                    None,
+                    (2,),
+                )
+            )
+        return specs
 
     # -- public API ----------------------------------------------------------
 

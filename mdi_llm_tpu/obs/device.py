@@ -38,14 +38,40 @@ Reports flow into the PR 7 surfaces: `ServingObserver.device` (a
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 __all__ = [
     "ExecutableReport",
+    "ExecutableSpec",
     "DeviceReportRegistry",
     "abstractify",
     "introspect",
 ]
+
+
+class ExecutableSpec(NamedTuple):
+    """One serving/generation executable, fully described for side-band AOT
+    work: the jitted callable plus the abstract argument signature it is
+    dispatched at.  Produced by the enumeration seams
+    (`ServingEngine.enumerate_executables`,
+    `Generator.enumerate_executables`) and consumed by `mdi-ir`
+    (analysis/ir.py) to trace/lower every executable without a backend.
+
+    `args` are `ShapeDtypeStruct` pytrees (see `abstractify`);
+    `static_kwargs` holds the jit static arguments (None when the fn has
+    none); `donate` mirrors the fn's `donate_argnums`."""
+
+    label: str  # dispatch path: mixed / decode / decode_chunk / verify / ...
+    key: Tuple  # static-shape key, e.g. (B, T)
+    fn: Any  # the jitted callable (supports .trace(*args, **static_kwargs))
+    args: Tuple  # abstract positional args, in dispatch order
+    static_kwargs: Optional[Dict[str, Any]]  # jit static args, or None
+    donate: Tuple[int, ...]  # donated positional indices (donate_argnums)
+
+    @property
+    def name(self) -> str:
+        ks = ",".join(str(k) for k in self.key)
+        return f"{self.label}({ks})"
 
 
 def abstractify(tree):

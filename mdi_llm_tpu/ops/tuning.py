@@ -55,6 +55,7 @@ __all__ = [
     "load_tuning_table",
     "save_tuning_table",
     "candidate_params",
+    "SERVE_TRACE_CASES",
     "autotune",
     "main",
 ]
@@ -361,6 +362,17 @@ def _make_case(n_head, n_groups, head_size, block_size, max_blocks,
     return q, k_pool, v_pool, tables, q_start, q_len, lens, q_pos
 
 
+# serving-engine mixed-step geometries, lifted from the serving-cb /
+# serving-open bench rows: the default ServingConfig packs
+# max_batch(8) + prefill_chunk(128) = 136 tokens into one ragged span
+# batch over 8 slots, and steady-state decode is 8 single-token lanes.
+# max_blocks=16 gives the prefill span a 256-token window to sit in.
+SERVE_TRACE_CASES: List[Dict[str, int]] = [
+    {"n_tokens": 136, "n_slots": 8, "max_blocks": 16},
+    {"n_tokens": 8, "n_slots": 8, "max_blocks": 16},
+]
+
+
 def _time_us(fn, reps: int) -> float:
     """Best-of-reps wall time of `fn()` in microseconds.  The device sync
     per rep is the measurement, not a hazard."""
@@ -384,36 +396,86 @@ def autotune(
     kv_dtype: Optional[str] = None,
     reps: int = 10,
     interpret: Optional[bool] = None,
+    cases: Optional[List[Dict[str, int]]] = None,
+    candidates: Optional[List[KernelParams]] = None,
 ) -> Tuple[KernelParams, List[Dict[str, Any]]]:
     """Sweep `candidate_params` for one geometry on the current backend
-    and return ``(winner, results)``; results rows carry ``params`` and
-    ``us``.  Off-TPU the sweep runs the kernel in interpret mode — the
-    timings are meaningless for performance but exercise every candidate,
-    which is what CPU CI wants."""
+    and return ``(winner, results)``.  Each candidate first passes the
+    ``bad-kernel-tuning`` preflight (divisibility via
+    `validate_kernel_params`, VMEM estimate vs
+    `obs/roofline.device_vmem_bytes`); rejects are never timed and their
+    rows carry ``params`` and ``rejected`` (the reasons) instead of
+    ``us``, so the persisted artifact records WHY an entry is absent.
+    Survivors are timed over every case in ``cases`` (ragged span-batch
+    geometries; default: the single n_tokens/n_slots case from the
+    arguments) and ranked by total time.  Off-TPU the sweep runs the
+    kernel in interpret mode — the timings are meaningless for
+    performance but exercise every candidate, which is what CPU CI
+    wants."""
     import jax
 
+    from mdi_llm_tpu.obs.roofline import device_vmem_bytes
     from mdi_llm_tpu.ops.ragged_paged_attention import ragged_paged_attention
 
     with jax.named_scope("mdi_tune_autotune"):
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
-        case = _make_case(
-            n_head, n_groups, head_size, block_size, max_blocks,
-            n_tokens, n_slots, kv_dtype,
-        )
-        q, k_pool, v_pool, tables, q_start, q_len, lens, q_pos = case
-        results: List[Dict[str, Any]] = []
-        for cand in candidate_params(block_size, n_groups, head_size):
-            fn = jax.jit(  # mdi-lint: disable=jit-in-loop -- one compile per candidate IS the sweep
-                lambda q_, cand_=cand: ragged_paged_attention(
-                    q_, k_pool, v_pool, tables, q_start, q_len, lens, q_pos,
-                    scale=1.0 / head_size ** 0.5, params=cand_,
-                    interpret=interpret,
-                )
+        if cases is None:
+            cases = [{"n_tokens": n_tokens, "n_slots": n_slots,
+                      "max_blocks": max_blocks}]
+        made = [
+            _make_case(
+                n_head, n_groups, head_size, block_size,
+                c.get("max_blocks", max_blocks),
+                c["n_tokens"], c["n_slots"], kv_dtype,
             )
-            us = _time_us(lambda: fn(q), reps)
-            results.append({"params": cand.to_dict(), "us": us})
-        best = min(results, key=lambda r: r["us"])
+            for c in cases
+        ]
+        vmem_budget = device_vmem_bytes(jax.devices()[0].device_kind)
+        worst_tokens = max(c["n_tokens"] for c in cases)
+        if candidates is None:
+            candidates = candidate_params(block_size, n_groups, head_size)
+        results: List[Dict[str, Any]] = []
+        for cand in candidates:
+            resolved = cand.resolved(block_size, n_groups, head_size)
+            problems = validate_kernel_params(
+                resolved, block_size, n_groups, head_size
+            )
+            need = estimate_kernel_vmem(
+                n_head, n_groups, head_size, worst_tokens, block_size,
+                resolved, kv_dtype=kv_dtype,
+            )
+            if need > vmem_budget:
+                problems.append(
+                    f"estimated VMEM {need} B exceeds the {vmem_budget} B "
+                    "budget for this device kind"
+                )
+            if problems:
+                results.append({"params": cand.to_dict(),
+                                "rejected": "; ".join(problems)})
+                continue
+            total = 0.0
+            for case in made:
+                q, k_pool, v_pool, tables, q_start, q_len, lens, q_pos = case
+                fn = jax.jit(  # mdi-lint: disable=jit-in-loop -- one compile per candidate IS the sweep
+                    lambda q_, cand_=cand, k_pool=k_pool, v_pool=v_pool,
+                    tables=tables, q_start=q_start, q_len=q_len, lens=lens,
+                    q_pos=q_pos: ragged_paged_attention(
+                        q_, k_pool, v_pool, tables, q_start, q_len, lens,
+                        q_pos, scale=1.0 / head_size ** 0.5, params=cand_,
+                        interpret=interpret,
+                    )
+                )
+                total += _time_us(lambda fn=fn, q=q: fn(q), reps)
+            results.append({"params": cand.to_dict(), "us": total})
+        timed = [r for r in results if "us" in r]
+        if not timed:
+            raise ValueError(
+                "every candidate was rejected by the bad-kernel-tuning "
+                "preflight for this geometry: "
+                + "; ".join(r["rejected"] for r in results)
+            )
+        best = min(timed, key=lambda r: r["us"])
     return KernelParams.from_dict(best["params"]), results
 
 
@@ -449,10 +511,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="paged-KV block size (ServingConfig.block_size)")
     ap.add_argument("--kv-dtype", default="fp", choices=["fp", "int8"],
                     help="pool dtype family to tune for")
-    ap.add_argument("--tokens", type=int, default=64,
-                    help="packed query tokens in the sweep batch")
-    ap.add_argument("--slots", type=int, default=4,
-                    help="ragged slots in the sweep batch")
+    ap.add_argument("--tokens", type=int, default=None,
+                    help="packed query tokens in the sweep batch (pins a "
+                    "single case; default: a 64-token case PLUS the "
+                    "serve-trace mixed-step geometries)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="ragged slots in the sweep batch (see --tokens)")
     ap.add_argument("--max-blocks", type=int, default=8,
                     help="blocks per slot table in the sweep batch")
     ap.add_argument("--reps", type=int, default=10,
@@ -485,17 +549,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     device = jax.devices()[0]
     kv_dtype = None if args.kv_dtype == "fp" else args.kv_dtype
     interpret = True if args.interpret else None
+    if args.tokens is None and args.slots is None:
+        # default case list: the classic 64-token sweep batch plus the
+        # serving engine's mixed-step geometries (serving-cb/serving-open
+        # token-budget packed spans), ranked by total time across all
+        cases = [{"n_tokens": 64, "n_slots": 4,
+                  "max_blocks": args.max_blocks}] + SERVE_TRACE_CASES
+    else:
+        cases = [{"n_tokens": args.tokens or 64, "n_slots": args.slots or 4,
+                  "max_blocks": args.max_blocks}]
     best, results = autotune(
         n_head, n_groups, head_size,
         block_size=args.block_size, max_blocks=args.max_blocks,
-        n_tokens=args.tokens, n_slots=args.slots, kv_dtype=kv_dtype,
-        reps=args.reps, interpret=interpret,
+        kv_dtype=kv_dtype, reps=args.reps, interpret=interpret,
+        cases=cases,
     )
     key = geometry_key(n_head, n_groups, head_size, kv_dtype,
                        args.block_size)
     default_us = next(
         (r["us"] for r in results
-         if KernelParams.from_dict(r["params"])
+         if "us" in r and KernelParams.from_dict(r["params"])
          == DEFAULT_PARAMS.resolved(args.block_size, n_groups, head_size)),
         None,
     )
@@ -503,11 +576,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.out, device.device_kind, {key: best.to_dict()},
         timings_us={key: results},
     )
-    for r in sorted(results, key=lambda r: r["us"]):
+    timed = [r for r in results if "us" in r]
+    for r in sorted(timed, key=lambda r: r["us"]):
         mark = " <-- best" if r["params"] == best.to_dict() else ""
         print(f"  {r['params']}  {r['us']:10.1f} us{mark}")
+    for r in results:
+        if "rejected" in r:
+            print(f"  {r['params']}  rejected: {r['rejected']}")
     if default_us:
-        best_us = min(r["us"] for r in results)
+        best_us = min(r["us"] for r in timed)
         print(f"tuned vs default: {default_us / best_us:.2f}x "
               f"({best_us:.1f} vs {default_us:.1f} us)")
     print(f"{key} on {device.device_kind}: wrote {args.out}")

@@ -447,10 +447,15 @@ class ServingEngine:
             self._fn_cache_key(), {}
         )
         # sampling knobs are engine-lifetime constants: upload the traced
-        # operands once, not two tiny transfers per decode step
-        self._t_op, self._p_op = sampling_operands(
-            serving.temperature, serving.top_p
-        )
+        # operands once, not two tiny transfers per decode step (abstract
+        # engines keep the shape/dtype only — nothing may touch a device)
+        if getattr(gen, "abstract", False):
+            self._t_op = jax.ShapeDtypeStruct((), jnp.float32)
+            self._p_op = jax.ShapeDtypeStruct((), jnp.float32)
+        else:
+            self._t_op, self._p_op = sampling_operands(
+                serving.temperature, serving.top_p
+            )
         self._sample_mode = sample_mode(
             serving.temperature, serving.top_k, serving.top_p
         )
@@ -501,7 +506,27 @@ class ServingEngine:
         """Allocate and place the device-side paged pool.  The base
         engine's flat (L, num_blocks, bs, G, hs) pool, tp-sharded along
         its KV-group axis; the pipeline engine overrides this with the
-        per-stage stacked layout."""
+        per-stage stacked layout.  On an abstract Generator the pool is a
+        ShapeDtypeStruct tree carrying the same shardings — zero bytes,
+        zero device work (the mdi-ir contract)."""
+        if getattr(self.gen, "abstract", False):
+            tmpl = jax.eval_shape(
+                lambda: transformer.init_paged_kv_cache(
+                    self.gen.cfg, num_blocks, bs, dtype=self._pool_dtype
+                )
+            )
+            pool_sh = self.gen._paged_kv_sharding
+            scale_sh = self.gen._paged_kv_scale_sharding
+
+            def leaf(l):
+                sh = None
+                if pool_sh is not None:
+                    sh = pool_sh if l.ndim == 5 else scale_sh
+                if sh is not None:
+                    return jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh)
+                return jax.ShapeDtypeStruct(l.shape, l.dtype)
+
+            return jax.tree_util.tree_map(leaf, tmpl)
         return self.gen._place_paged_kv(transformer.init_paged_kv_cache(
             self.gen.cfg, num_blocks, bs, dtype=self._pool_dtype
         ))
@@ -680,6 +705,100 @@ class ServingEngine:
 
             self._fns[key_] = verify
         return self._fns[key_]
+
+    # -- static enumeration (analysis/ir.py) ---------------------------------
+
+    def reachable_signatures(self) -> List[Tuple[str, Tuple[int, ...]]]:
+        """Every (label, shape-key) `step()` can dispatch for THIS engine's
+        ServingConfig — the compile set the warmup pass and the
+        zero-post-warmup-recompile contract must cover:
+
+        - ``mixed(max_batch, token_budget)`` always (prefill + decode pack
+          into the one unified step);
+        - ``verify(max_batch, spec_k + 1)`` when speculative decoding is on
+          (spec_k > 0) — and spec decode FALLS THROUGH to the plain decode
+          path whenever no slot drafts, so the decode entry below stays
+          reachable alongside it;
+        - ``decode_chunk(max_batch, decode_chunk)`` when decode_chunk > 1,
+          else ``decode(max_batch,)``.
+
+        mdi-ir's compile-set-closure rule re-derives this set independently
+        from the ServingConfig and diffs it against
+        `enumerate_executables()`, so an engine subclass that forgets a
+        dispatch path here is caught statically."""
+        B = self.scheduler.max_batch
+        sigs: List[Tuple[str, Tuple[int, ...]]] = [
+            ("mixed", (B, self.token_budget))
+        ]
+        if self.cfg.spec_k:
+            sigs.append(("verify", (B, self.cfg.spec_k + 1)))
+        if self.cfg.decode_chunk > 1:
+            sigs.append(("decode_chunk", (B, self.cfg.decode_chunk)))
+        else:
+            sigs.append(("decode", (B,)))
+        return sigs
+
+    def enumerate_executables(self) -> List[Any]:
+        """One abstract `ExecutableSpec` per reachable signature: the
+        exact jitted callable each dispatch site calls, with
+        ShapeDtypeStruct arguments mirroring the `_run_*` operand
+        construction (shapes, dtypes AND shardings — the pool specs ride
+        on the kv ShapeDtypeStructs).  Works on live engines
+        (`abstractify` strips real buffers to their signatures) and on
+        abstract ones (`Generator(abstract=True)`) identically; building
+        the specs constructs closures but traces/compiles nothing.  The
+        pipeline engine inherits this unchanged — its overridden
+        `_mixed_fn`/... builders hand back the staged-ring variants under
+        the same labels and keys."""
+        from mdi_llm_tpu.obs.device import ExecutableSpec, abstractify
+
+        B = self.scheduler.max_batch
+        i32 = jnp.int32
+        sds = jax.ShapeDtypeStruct
+        params = abstractify(self._params)
+        kv = abstractify(self._kv)
+        tables = sds((B, self.max_blocks_per_seq), i32)
+        key = abstractify(self.gen.key)
+        t_op = abstractify(self._t_op)
+        p_op = abstractify(self._p_op)
+        statics = {"mode": self._sample_mode, "top_k": self.cfg.top_k}
+        specs: List[Any] = []
+        for label, k in self.reachable_signatures():
+            if label == "mixed":
+                T = k[1]
+                args = (
+                    params, sds((1, T), i32), kv, tables, sds((1, T), i32),
+                    sds((T,), i32), sds((B,), i32), sds((B,), i32),
+                    sds((B,), i32), key, t_op, p_op,
+                )
+                specs.append(ExecutableSpec(
+                    "mixed", k, self._mixed_fn(B, T), args, dict(statics), (2,)
+                ))
+            elif label == "decode":
+                args = (
+                    params, sds((B,), i32), kv, tables, sds((B,), i32),
+                    key, t_op, p_op,
+                )
+                specs.append(ExecutableSpec(
+                    "decode", k, self._decode_fn(B), args, dict(statics), (2,)
+                ))
+            elif label == "decode_chunk":
+                K = k[1]
+                args = (
+                    params, sds((B,), i32), kv, tables, sds((B,), i32),
+                    sds((B,), i32), sds((B,), i32), key, t_op, p_op,
+                )
+                specs.append(ExecutableSpec(
+                    "decode_chunk", k, self._decode_chunk_fn(B, K), args,
+                    dict(statics), (2,),
+                ))
+            elif label == "verify":
+                T = k[1]
+                args = (params, sds((B, T), i32), kv, tables, sds((B,), i32))
+                specs.append(ExecutableSpec(
+                    "verify", k, self._verify_fn(B, T), args, None, (2,)
+                ))
+        return specs
 
     # -- device-side introspection (obs/device.py) ---------------------------
 

@@ -217,7 +217,22 @@ class PipelinedServingEngine(ServingEngine):
         # additionally follow the Megatron specs so GSPMD (tp is an auto
         # axis of the ring shard_map) places the per-stage all-reduces
         stages = split_params(gen.cfg, gen.params, S)
-        blocks_np = pad_stage_blocks(stages, self._l_max)
+        abstract = getattr(gen, "abstract", False)
+        if abstract:
+            # shape-level mirror of pad_stage_blocks: the padded/stacked
+            # result is (S, l_max, ...) per leaf regardless of per-stage
+            # layer counts, so zero-stride stubs stand in for the stacked
+            # weights without materializing a byte (the mdi-ir contract)
+            def _stage_stub(leaf):
+                leaf = np.asarray(leaf)
+                shape = (S, self._l_max) + tuple(leaf.shape[1:])
+                return np.broadcast_to(np.zeros((), leaf.dtype), shape)
+
+            blocks_np = jax.tree_util.tree_map(
+                _stage_stub, stages[0]["blocks"]
+            )
+        else:
+            blocks_np = pad_stage_blocks(stages, self._l_max)
         repl_sh = NamedSharding(mesh, P())
         if tp > 1:
             from mdi_llm_tpu.parallel.sharding import (
@@ -229,17 +244,34 @@ class PipelinedServingEngine(ServingEngine):
                 param_specs(gen.cfg, "tp")["blocks"], blocks_np,
                 leading_axes=1, axis_sizes={"tp": tp},
             )
-            stage_blocks = jax.tree_util.tree_map(
-                lambda a, sp: jax.device_put(
-                    a, NamedSharding(mesh, P("pp", *sp))
-                ),
-                blocks_np, bspecs,
-            )
+            if abstract:
+                stage_blocks = jax.tree_util.tree_map(
+                    lambda a, sp: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype,
+                        sharding=NamedSharding(mesh, P("pp", *sp)),
+                    ),
+                    blocks_np, bspecs,
+                )
+            else:
+                stage_blocks = jax.tree_util.tree_map(
+                    lambda a, sp: jax.device_put(
+                        a, NamedSharding(mesh, P("pp", *sp))
+                    ),
+                    blocks_np, bspecs,
+                )
         else:
             pipe_sh = NamedSharding(mesh, P("pp"))
-            stage_blocks = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, pipe_sh), blocks_np
-            )
+            if abstract:
+                stage_blocks = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=pipe_sh
+                    ),
+                    blocks_np,
+                )
+            else:
+                stage_blocks = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, pipe_sh), blocks_np
+                )
         # embedding / final norm / head replicated on every stage (only
         # stage 0 reads them meaningfully; the ring samples at
         # single-device shapes outside the shard_map)
@@ -247,12 +279,24 @@ class PipelinedServingEngine(ServingEngine):
             k: stages[0][k]
             for k in ("wte", "wpe", "ln_f", "lm_head") if k in stages[0]
         }
-        head_params = jax.tree_util.tree_map(
-            lambda a: jax.device_put(np.asarray(a), repl_sh), head_params
-        )
-        rope = tuple(
-            jax.device_put(np.asarray(r), repl_sh) for r in gen.rope
-        )
+        if abstract:
+            head_params = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    np.shape(a), np.asarray(a).dtype, sharding=repl_sh
+                ),
+                head_params,
+            )
+            rope = tuple(
+                jax.ShapeDtypeStruct(r.shape, r.dtype, sharding=repl_sh)
+                for r in gen.rope
+            )
+        else:
+            head_params = jax.tree_util.tree_map(
+                lambda a: jax.device_put(np.asarray(a), repl_sh), head_params
+            )
+            rope = tuple(
+                jax.device_put(np.asarray(r), repl_sh) for r in gen.rope
+            )
         # the bundle every inherited dispatch passes (engine._params seam)
         self._params = {
             "blocks": stage_blocks, "head": head_params, "rope": rope,
@@ -290,11 +334,15 @@ class PipelinedServingEngine(ServingEngine):
             )
         tmpl = fns[tkey]
         mesh = self.gen.mesh
+        abstract = getattr(self.gen, "abstract", False)
 
         def alloc(leaf):
-            arr = np.zeros((self._pp,) + tuple(leaf.shape), leaf.dtype)
-            spec = self._pool_spec if arr.ndim >= 5 else self._scale_spec
-            return jax.device_put(arr, NamedSharding(mesh, spec))
+            shape = (self._pp,) + tuple(leaf.shape)
+            spec = self._pool_spec if len(shape) >= 5 else self._scale_spec
+            sh = NamedSharding(mesh, spec)
+            if abstract:  # the stacked layout + shardings, zero bytes
+                return jax.ShapeDtypeStruct(shape, leaf.dtype, sharding=sh)
+            return jax.device_put(np.zeros(shape, leaf.dtype), sh)
 
         return jax.tree_util.tree_map(alloc, tmpl)
 

@@ -1,0 +1,466 @@
+"""mdi-ir: trace-level static analysis of the serving compile set.
+
+Three layers under test:
+
+1. the per-rule checkers — every rule has a PLANTED-bug fixture it must
+   catch and a clean twin it must pass (the trip-wire style mdi-audit
+   established: a check that can't fail proves nothing);
+2. the enumeration seams — `ServingEngine.enumerate_executables()` must
+   cover every `step()`-dispatchable signature (incl. spec_k verify and
+   the pp ring variants) and the whole abstract pass must never touch a
+   backend or a device;
+3. the CLI — exit codes 0/1/2, `--format json`, suppression
+   justifications, and the mdi-lint Baseline round-trip.
+
+The repo self-check (registry model at single-device, tp=2, pp=2,
+findings: none) runs here in tier-1, so a serving change that opens a
+zero-recompile hole or drops a donation fails CI before any benchmark.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mdi_llm_tpu.analysis.core import Baseline
+from mdi_llm_tpu.analysis.ir import (
+    IR_RULES,
+    IrReport,
+    analyze_executables,
+    enforce_ir_preflight,
+    ir_detail,
+    ir_preflight,
+    main,
+    reachable_serving_set,
+    trace_serving,
+)
+from mdi_llm_tpu.config import Config, ServingConfig
+from mdi_llm_tpu.obs.device import ExecutableSpec
+from mdi_llm_tpu.parallel.mesh import make_mesh
+
+sds = jax.ShapeDtypeStruct
+f32, bf16, i32 = jnp.float32, jnp.bfloat16, jnp.int32
+
+MODEL = "pythia-14m"  # the registry self-check model
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# per-rule planted-bug / clean fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_dropped_donation_planted_and_clean():
+    # planted: the donated (8,8) buffer matches NO output shape, so JAX
+    # silently keeps both copies — exactly the bug the rule exists for
+    bad = jax.jit(lambda a, b: jnp.sum(b), donate_argnums=(0,))
+    spec = ExecutableSpec(
+        "drop", (8,), bad, (sds((8, 8), f32), sds((8, 8), f32)), None, (0,)
+    )
+    findings, records = analyze_executables([spec], origin="t")
+    assert rules_of(findings) == ["dropped-donation"]
+    assert "2x HBM" in findings[0].message
+    assert records[0]["donated"] == 1
+
+    good = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    spec = ExecutableSpec(
+        "ok", (8,), good, (sds((8, 8), f32), sds((8, 8), f32)), None, (0,)
+    )
+    findings, _ = analyze_executables([spec], origin="t")
+    assert findings == []
+
+
+def test_callback_in_executable_planted_and_clean():
+    def with_print(a):
+        jax.debug.print("tok {}", a.sum())
+        return a * 2
+
+    spec = ExecutableSpec(
+        "cb", (), jax.jit(with_print), (sds((4,), f32),), None, ()
+    )
+    findings, _ = analyze_executables([spec], origin="t")
+    assert rules_of(findings) == ["callback-in-executable"]
+    assert "debug_callback" in findings[0].line_text
+
+    spec = ExecutableSpec(
+        "nocb", (), jax.jit(lambda a: a * 2), (sds((4,), f32),), None, ()
+    )
+    findings, _ = analyze_executables([spec], origin="t")
+    assert findings == []
+
+
+def test_baked_constant_bloat_planted_and_clean():
+    big = jnp.arange(2048, dtype=jnp.float32)  # 8 KiB closure constant
+    spec = ExecutableSpec(
+        "bloat", (), jax.jit(lambda a: a + big), (sds((2048,), f32),),
+        None, (),
+    )
+    findings, _ = analyze_executables([spec], origin="t",
+                                      max_const_bytes=1024)
+    assert rules_of(findings) == ["baked-constant-bloat"]
+    assert "float32" in findings[0].line_text
+    # same executable, sane threshold: the constant is fine
+    findings, _ = analyze_executables([spec], origin="t",
+                                      max_const_bytes=1 << 20)
+    assert findings == []
+
+
+def test_dtype_promotion_leak_planted_and_clean():
+    leak = jax.jit(lambda a, w: a.astype(f32) @ w.astype(f32))
+    spec = ExecutableSpec(
+        "leak", (), leak, (sds((4, 8), bf16), sds((8, 4), bf16)), None, ()
+    )
+    findings, _ = analyze_executables([spec], origin="t",
+                                      compute_dtype="bfloat16")
+    assert rules_of(findings) == ["dtype-promotion-leak"]
+    assert findings[0].line_text == "leak:bfloat16"
+    # f32 params: upcasts are the compute dtype, not a leak
+    findings, _ = analyze_executables([spec], origin="t",
+                                      compute_dtype="float32")
+    assert findings == []
+    # bf16 straight through the matmul: clean
+    spec = ExecutableSpec(
+        "noleak", (), jax.jit(lambda a, w: a @ w),
+        (sds((4, 8), bf16), sds((8, 4), bf16)), None, (),
+    )
+    findings, _ = analyze_executables([spec], origin="t",
+                                      compute_dtype="bfloat16")
+    assert findings == []
+
+
+def test_sharding_constraint_drift_planted_and_clean(devices):
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    declared = NamedSharding(mesh, P(None, "tp"))
+    drifted = NamedSharding(mesh, P("tp", None))
+    kv = sds((4, 8), f32, sharding=declared)
+
+    def pinned(sh):
+        return jax.jit(
+            lambda p, kv_: jax.lax.with_sharding_constraint(kv_, sh) * 1.0,
+            donate_argnums=(1,),
+        )
+
+    spec = ExecutableSpec(
+        "drift", (), pinned(drifted), (sds((2,), f32), kv), None, (1,)
+    )
+    findings, _ = analyze_executables([spec], origin="t",
+                                      check_donation=False)
+    assert rules_of(findings) == ["sharding-constraint-drift"]
+    assert "resharding" in findings[0].message
+
+    spec = ExecutableSpec(
+        "nodrift", (), pinned(declared), (sds((2,), f32), kv), None, (1,)
+    )
+    findings, _ = analyze_executables([spec], origin="t",
+                                      check_donation=False)
+    assert findings == []
+
+
+def test_trace_failure_is_a_finding_not_a_crash():
+    def explodes(a):
+        raise RuntimeError("boom")
+
+    spec = ExecutableSpec(
+        "boom", (), jax.jit(explodes), (sds((4,), f32),), None, ()
+    )
+    findings, records = analyze_executables([spec], origin="t")
+    assert rules_of(findings) == ["trace-failure"]
+    assert "error" in records[0]
+
+
+# ---------------------------------------------------------------------------
+# compile-set closure + enumeration completeness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "serving,expect",
+    [
+        (dict(), {"mixed", "decode_chunk"}),
+        (dict(decode_chunk=1), {"mixed", "decode"}),
+        (dict(spec_k=3), {"mixed", "decode_chunk", "verify"}),
+        (dict(spec_k=3, decode_chunk=1), {"mixed", "decode", "verify"}),
+    ],
+)
+def test_enumeration_covers_every_step_dispatch_path(serving, expect):
+    """Every `step()` branch (mixed, chunked/plain decode, speculative
+    verify) appears in the enumerated set, and the enumeration equals the
+    independently re-derived reachable set — the closure proof."""
+    cfg = Config.from_name(MODEL)
+    engine = trace_serving(cfg, ServingConfig(**serving),
+                           max_seq_length=256)
+    specs = engine.enumerate_executables()
+    assert {s.label for s in specs} == expect
+    enumerated = {(s.label, tuple(s.key)) for s in specs}
+    reachable = reachable_serving_set(
+        engine.cfg, engine.scheduler.max_batch, engine.token_budget
+    )
+    assert enumerated == reachable
+    # shape keys carry the config numbers, not defaults
+    (mixed_key,) = [k for (lbl, k) in enumerated if lbl == "mixed"]
+    assert mixed_key == (engine.scheduler.max_batch, engine.token_budget)
+
+
+def test_pp_ring_engine_enumerates_the_same_compile_set(devices):
+    """The pipelined engine inherits the enumeration seam: its staged-ring
+    executables trace under the same labels/keys, so the closure rule
+    covers pp serving too."""
+    from mdi_llm_tpu.serving.pipeline import PipelinedServingEngine
+
+    cfg = Config.from_name(MODEL)
+    engine = trace_serving(cfg, ServingConfig(spec_k=3), pp=2,
+                           max_seq_length=256)
+    assert isinstance(engine, PipelinedServingEngine)
+    specs = engine.enumerate_executables()
+    assert {s.label for s in specs} == {"mixed", "decode_chunk", "verify"}
+    # donation lowering rides the (tp,pp) self-check below; skip it here
+    report = ir_preflight(engine, origin="pp-ring", check_donation=False)
+    assert [f for f in report.findings
+            if f.rule == "compile-set-closure"] == []
+
+
+def test_planted_compile_set_hole_is_caught(monkeypatch):
+    """An engine that forgets to warm the speculative verify path (the
+    classic zero-recompile hole: first draft acceptance compiles
+    MID-SERVE) must fail the closure rule."""
+    from mdi_llm_tpu.serving.engine import ServingEngine
+
+    cfg = Config.from_name(MODEL)
+    engine = trace_serving(cfg, ServingConfig(spec_k=3),
+                           max_seq_length=256)
+    real = ServingEngine.enumerate_executables
+
+    monkeypatch.setattr(
+        ServingEngine, "enumerate_executables",
+        lambda self: [s for s in real(self) if s.label != "verify"],
+    )
+    report = ir_preflight(engine, origin="holey", check_donation=False)
+    holes = [f for f in report.findings if f.rule == "compile-set-closure"]
+    assert len(holes) == 1
+    assert holes[0].line_text.startswith("missing:verify")
+    assert "MID-SERVE" in holes[0].message
+
+
+def test_planted_dead_warmup_is_caught(monkeypatch):
+    """The dual hole: enumerating an executable no step() branch reaches
+    (here: a verify shape while spec_k=0) is dead warmup."""
+    from mdi_llm_tpu.obs.device import abstractify
+    from mdi_llm_tpu.serving.engine import ServingEngine
+
+    cfg = Config.from_name(MODEL)
+    engine = trace_serving(cfg, ServingConfig(), max_seq_length=256)
+    real = ServingEngine.enumerate_executables
+
+    def extra(self):
+        specs = real(self)
+        B = self.scheduler.max_batch
+        args = (abstractify(self._params), sds((B, 5), i32),
+                abstractify(self._kv),
+                sds((B, self.max_blocks_per_seq), i32), sds((B,), i32))
+        specs.append(ExecutableSpec(
+            "verify", (B, 5), self._verify_fn(B, 5), args, None, (2,)
+        ))
+        return specs
+
+    monkeypatch.setattr(ServingEngine, "enumerate_executables", extra)
+    report = ir_preflight(engine, origin="dead", check_donation=False)
+    dead = [f for f in report.findings if f.rule == "compile-set-closure"]
+    assert len(dead) == 1
+    assert dead[0].line_text.startswith("unreachable:verify")
+
+
+def test_sequential_enumeration_covers_generate_paths():
+    cfg = Config.from_name(MODEL)
+    engine = trace_serving(cfg, ServingConfig(), max_seq_length=256)
+    specs = engine.gen.enumerate_executables(
+        batch_size=2, prompt_len=32, max_new_tokens=16, chunk_size=8,
+        speculative=3,
+    )
+    labels = {s.label for s in specs}
+    assert {"prefill", "decode_chunk", "verify"} <= labels
+    findings, records = analyze_executables(
+        specs, origin="seq", compute_dtype="bfloat16"
+    )
+    assert findings == []
+    assert all("eqns" in r for r in records)
+
+
+# ---------------------------------------------------------------------------
+# the repo self-check: registry model, three meshes, zero device use
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tp,pp", [(1, 1), (2, 1), (1, 2)])
+def test_self_check_clean_and_never_touches_a_backend(tp, pp, monkeypatch,
+                                                      devices):
+    """The acceptance gate: the full abstract pass (engine construction,
+    enumeration, tracing, lowering, every rule) on the registry model is
+    CLEAN at single-device, tp=2 and pp=2 — and a trip-wired
+    backend_compile / device_put proves no rule ever compiles or places a
+    buffer (the mdi-audit trip-wire style)."""
+    from jax._src import compiler as jax_compiler
+
+    def tripped(*a, **k):
+        raise AssertionError("mdi-ir touched a backend/device")
+
+    monkeypatch.setattr(jax_compiler, "backend_compile", tripped)
+    monkeypatch.setattr(jax, "device_put", tripped)
+
+    cfg = Config.from_name(MODEL)
+    engine = trace_serving(
+        cfg, ServingConfig(spec_k=3), tp=tp, pp=pp, max_seq_length=256
+    )
+    report = ir_preflight(engine, origin=f"self@tp{tp}pp{pp}")
+    assert report.findings == [], report.render_text()
+    assert len(report.executables) == 3  # mixed, decode_chunk, verify
+    assert all(r["eqns"] > 0 and r["donated"] >= 1
+               for r in report.executables)
+
+
+# ---------------------------------------------------------------------------
+# preflight gate + detail record (bench.py / mdi-serve wiring)
+# ---------------------------------------------------------------------------
+
+
+def test_enforce_ir_preflight_refuses_on_errors_allows_with_flag():
+    cfg = Config.from_name(MODEL)
+    engine = trace_serving(cfg, ServingConfig(), max_seq_length=256)
+    report = ir_preflight(engine, origin="gate", check_donation=False)
+    emitted = []
+    assert enforce_ir_preflight(report, "bench", emit=emitted.append)
+    assert emitted == []  # clean pass stays silent
+
+    bad = jax.jit(lambda a, b: jnp.sum(b), donate_argnums=(0,))
+    spec = ExecutableSpec(
+        "drop", (8,), bad, (sds((8, 8), f32), sds((8, 8), f32)), None, (0,)
+    )
+    findings, records = analyze_executables([spec], origin="gate")
+    broken = IrReport(origin="gate", findings=findings, executables=records)
+    with pytest.raises(SystemExit, match="no-preflight"):
+        enforce_ir_preflight(broken, "bench", emit=emitted.append)
+    assert any("dropped-donation" in line for line in emitted)
+    assert enforce_ir_preflight(broken, "bench", allow=True,
+                                emit=emitted.append)
+
+    d = ir_detail(broken)
+    assert d["findings"] == 1 and d["warnings"] == 0
+    assert "drop(8)" in d["executables"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, json, suppression, baseline round-trip, help
+# ---------------------------------------------------------------------------
+
+
+def test_cli_clean_self_check_exit_0(capsys):
+    rc = main(["--model", MODEL, "--seq-len", "256"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "findings: none" in out and "mixed(8,136)" in out
+
+
+def test_cli_findings_exit_1_and_json(monkeypatch, capsys):
+    from mdi_llm_tpu.serving.engine import ServingEngine
+
+    real = ServingEngine.enumerate_executables
+    monkeypatch.setattr(
+        ServingEngine, "enumerate_executables",
+        lambda self: [s for s in real(self) if s.label != "verify"],
+    )
+    rc = main(["--model", MODEL, "--seq-len", "256", "--spec-k", "3",
+               "--no-donation-check", "--format", "json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["errors"] >= 1 and out["new_errors"] >= 1
+    assert any(f["rule"] == "compile-set-closure" for f in out["findings"])
+    assert all("severity" in f for f in out["findings"])
+
+
+def test_cli_suppress_needs_known_rule_and_justification(monkeypatch,
+                                                         capsys):
+    assert main(["--model", MODEL, "--suppress", "not-a-rule=x"]) == 2
+    assert main(["--model", MODEL, "--suppress",
+                 "compile-set-closure="]) == 2
+    capsys.readouterr()
+    # a JUSTIFIED suppression turns the planted hole's exit 1 into 0 and
+    # records the why
+    from mdi_llm_tpu.serving.engine import ServingEngine
+
+    real = ServingEngine.enumerate_executables
+    monkeypatch.setattr(
+        ServingEngine, "enumerate_executables",
+        lambda self: [s for s in real(self) if s.label != "verify"],
+    )
+    rc = main(["--model", MODEL, "--seq-len", "256", "--spec-k", "3",
+               "--no-donation-check", "--suppress",
+               "compile-set-closure=known hole, tracked in #42"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "suppressed: compile-set-closure (known hole" in out
+
+
+def test_cli_baseline_round_trip(tmp_path, monkeypatch, capsys):
+    """mdi-lint's Baseline grandfathers mdi-ir findings: update-baseline
+    on the planted hole, then the same run against that baseline exits
+    0 while a text run still prints the finding."""
+    from mdi_llm_tpu.serving.engine import ServingEngine
+
+    real = ServingEngine.enumerate_executables
+    monkeypatch.setattr(
+        ServingEngine, "enumerate_executables",
+        lambda self: [s for s in real(self) if s.label != "verify"],
+    )
+    base = tmp_path / "ir-baseline.json"
+    planted = ["--model", MODEL, "--seq-len", "256", "--spec-k", "3",
+               "--no-donation-check"]
+    assert main(planted + ["--update-baseline", str(base)]) == 0
+    assert main(planted + ["--baseline", str(base)]) == 0
+    capsys.readouterr()
+    # the baseline grandfathers; without it the same run still fails
+    assert main(planted) == 1
+
+
+def test_baseline_api_round_trip(tmp_path):
+    bad = jax.jit(lambda a, b: jnp.sum(b), donate_argnums=(0,))
+    spec = ExecutableSpec(
+        "drop", (8,), bad, (sds((8, 8), f32), sds((8, 8), f32)), None, (0,)
+    )
+    findings, _ = analyze_executables([spec], origin="t")
+    path = tmp_path / "b.json"
+    Baseline.from_findings(findings).save(path)
+    new, old = Baseline.load(path).split(findings)
+    assert new == [] and old == findings
+
+
+def test_cli_usage_errors_exit_2(capsys):
+    assert main([]) == 2  # no --model/--config
+    assert main(["--model", "no-such-model-xyz"]) == 2
+    err = capsys.readouterr().err
+    assert "mdi-ir:" in err
+
+
+def test_cli_list_checks_covers_registry(capsys):
+    assert main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    for rule in IR_RULES:
+        assert rule in out
+
+
+def test_cli_help_covers_flags(capsys):
+    with pytest.raises(SystemExit):
+        main(["--help"])
+    text = capsys.readouterr().out
+    for flag in ("--model", "--config", "--tp", "--pp", "--seq-len",
+                 "--dtype", "--quantize", "--block-size", "--max-batch",
+                 "--prefill-chunk", "--token-budget", "--decode-chunk",
+                 "--spec-k", "--kv-dtype", "--sequential", "--speculative",
+                 "--max-const-bytes", "--no-donation-check", "--suppress",
+                 "--baseline", "--update-baseline", "--format",
+                 "--list-checks"):
+        assert flag in text, f"{flag} missing from mdi-ir --help"

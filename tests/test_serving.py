@@ -585,6 +585,30 @@ def test_speculative_requires_greedy(served_model):
         gen.serve(decode_chunk=0)
 
 
+@pytest.mark.parametrize("spec_k,chunk", [(4, 4)])
+def test_post_warmup_steps_pass_transfer_guard(served_model, spec_k, chunk):
+    """Steady-state serving must do only EXPLICIT transfers: a warmed
+    engine's steps run clean under ``jax.transfer_guard("disallow")``.
+    An implicit host->device transfer here means a step is re-baking a
+    host constant per dispatch; an implicit device->host means a hidden
+    sync the chunked loop was built to amortize."""
+    cfg, params = served_model
+    rng = np.random.default_rng(11)
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = gen.serve(block_size=4, max_batch=2, decode_chunk=chunk,
+                       spec_k=spec_k)
+    mk = lambda: rng.integers(1, cfg.vocab_size, 9).tolist()
+    engine.add_request("warm0", mk(), 6)
+    engine.add_request("warm1", mk(), 6)
+    engine.run()  # warmup traces every reachable executable
+    engine.add_request("a", mk(), 6)
+    engine.add_request("b", mk(), 6)
+    with jax.transfer_guard("disallow"):
+        while engine.step():
+            pass
+    assert set(engine._results) >= {"a", "b"}
+
+
 def test_shared_fn_cache_does_not_pin_dead_engines(served_model):
     """Compiled serving fns live on the Generator (so a warmup engine and
     its timed twin share one jit cache — zero re-traces), but the closures

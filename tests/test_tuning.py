@@ -256,3 +256,76 @@ def test_cli_help_covers_tuning_flags(capsys):
                  "--reps", "--out", "--interpret"):
         assert flag in help_text, f"{flag} missing from mdi-tune --help"
     assert "MDI_TUNE_TABLE" in help_text
+
+
+# ---------------------------------------------------------------------------
+# candidate preflight (bad-kernel-tuning BEFORE timing) + serve-trace cases
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_rejects_invalid_candidate_before_timing():
+    from mdi_llm_tpu.ops.tuning import SERVE_TRACE_CASES  # noqa: F401
+
+    bad = KernelParams(kv_step=3, q_pack=1, scratch_width=128)
+    good = KernelParams(kv_step=8, q_pack=1, scratch_width=128)
+    best, results = autotune(
+        n_head=4, n_groups=2, head_size=8, block_size=8, max_blocks=2,
+        n_tokens=8, n_slots=2, reps=1, candidates=[bad, good],
+    )
+    # every candidate keeps a row (the artifact records WHY one is absent)
+    assert len(results) == 2
+    rej = [r for r in results if "rejected" in r]
+    assert len(rej) == 1
+    assert rej[0]["params"]["kv_step"] == 3
+    assert "divisor" in rej[0]["rejected"]
+    assert "us" not in rej[0]  # never timed
+    assert best == KernelParams.from_dict(good.to_dict())
+
+
+def test_autotune_all_rejected_raises():
+    bad = KernelParams(kv_step=3, q_pack=1, scratch_width=128)
+    with pytest.raises(ValueError, match="bad-kernel-tuning"):
+        autotune(
+            n_head=4, n_groups=2, head_size=8, block_size=8, max_blocks=2,
+            n_tokens=8, n_slots=2, reps=1, candidates=[bad],
+        )
+
+
+def test_autotune_rejected_rows_persist_in_artifact(tmp_path):
+    bad = KernelParams(kv_step=3, q_pack=1, scratch_width=128)
+    good = KernelParams(kv_step=8, q_pack=1, scratch_width=128)
+    _, results = autotune(
+        n_head=4, n_groups=2, head_size=8, block_size=8, max_blocks=2,
+        n_tokens=8, n_slots=2, reps=1, candidates=[bad, good],
+    )
+    out = tmp_path / "tuned.json"
+    key = geometry_key(4, 2, 8, None, 8)
+    save_tuning_table(str(out), "cpu", {key: good.to_dict()},
+                      timings_us={key: results})
+    table = json.loads(out.read_text())
+    rows = table["timings_us"][key]
+    assert any("rejected" in r for r in rows)
+
+
+def test_autotune_multi_case_sums_timings():
+    cases = [
+        {"n_tokens": 8, "n_slots": 2, "max_blocks": 2},
+        {"n_tokens": 10, "n_slots": 2, "max_blocks": 2},
+    ]
+    good = KernelParams(kv_step=8, q_pack=1, scratch_width=128)
+    _, results = autotune(
+        n_head=4, n_groups=2, head_size=8, block_size=8, max_blocks=2,
+        reps=1, candidates=[good], cases=cases,
+    )
+    assert len(results) == 1 and results[0]["us"] > 0
+
+
+def test_serve_trace_cases_cover_token_budget_geometry():
+    from mdi_llm_tpu.ops.tuning import SERVE_TRACE_CASES
+
+    # the default ServingConfig packs max_batch(8)+prefill_chunk(128)
+    # tokens; the span must fit the case's block window
+    geo = {(c["n_tokens"], c["n_slots"]) for c in SERVE_TRACE_CASES}
+    assert (136, 8) in geo and (8, 8) in geo
+    for c in SERVE_TRACE_CASES:
+        assert c["n_tokens"] - (c["n_slots"] - 1) <= c["max_blocks"] * 16
