@@ -693,6 +693,15 @@ def run_serve(args):
     ir_report = ir_preflight(warm, origin=f"bench:{args.model}")
     enforce_ir_preflight(ir_report, "bench", allow=args.no_preflight)
 
+    # buffer-liveness preflight (mdi-flow) over the same compile set:
+    # donation aliasing + static peak-HBM land in detail.liveness
+    from mdi_llm_tpu.analysis.liveness import (
+        enforce_flow_preflight, flow_detail, flow_preflight,
+    )
+
+    flow_report = flow_preflight(warm, origin=f"bench:{args.model}")
+    enforce_flow_preflight(flow_report, "bench", allow=args.no_preflight)
+
     for rid, prompt, new in trace:
         warm.add_request(
             rid, prompt, min(new, max(2, 2 * args.serve_chunk))
@@ -840,6 +849,7 @@ def run_serve(args):
         },
         "audit": audit,
         "ir": ir_detail(ir_report),
+        "liveness": flow_detail(flow_report),
         "baseline_tokens_per_s": base,
         "config": {
             "model": args.model, "slots": args.batch,
@@ -922,6 +932,13 @@ def run_serve_open(args):
 
     ir_report = ir_preflight(warm, origin=f"bench:{args.model}")
     enforce_ir_preflight(ir_report, "bench", allow=args.no_preflight)
+
+    from mdi_llm_tpu.analysis.liveness import (
+        enforce_flow_preflight, flow_detail, flow_preflight,
+    )
+
+    flow_report = flow_preflight(warm, origin=f"bench:{args.model}")
+    enforce_flow_preflight(flow_report, "bench", allow=args.no_preflight)
     for rid, prompt, new in trace:
         warm.add_request(rid, prompt, min(new, max(2, 2 * args.serve_chunk)))
     warm.run()
@@ -1022,6 +1039,7 @@ def run_serve_open(args):
             "stats": head.get("stats"),
             "audit": audit,
             "ir": ir_detail(ir_report),
+            "liveness": flow_detail(flow_report),
             "device": device_block,
             "config": {
                 "model": args.model, "slots": args.batch,

@@ -37,11 +37,19 @@ __all__ = [
     "preflight",
     # mdi-ir (lazy for the same reason: tracing needs jax)
     "IR_RULES", "IrReport", "ir_preflight", "trace_serving",
+    # mdi-flow (lazy: liveness shares mdi-ir's trace seam)
+    "FLOW_RULES", "ExecProfile", "FlowReport", "analyze_flow",
+    "flow_preflight", "jaxpr_digest", "profile_executable",
+    # mdi-check (lazy: the aggregate gate pulls in every family)
+    "FAMILIES", "run_check",
 ]
 
 _AUDIT_NAMES = {"AUDIT_RULES", "AuditReport", "audit_plan", "preflight"}
 _PLAN_NAMES = {"MeshSpec", "PlanSpec"}
 _IR_NAMES = {"IR_RULES", "IrReport", "ir_preflight", "trace_serving"}
+_FLOW_NAMES = {"FLOW_RULES", "ExecProfile", "FlowReport", "analyze_flow",
+               "flow_preflight", "jaxpr_digest", "profile_executable"}
+_CHECK_NAMES = {"FAMILIES", "run_check"}
 
 
 def __getattr__(name):
@@ -57,4 +65,12 @@ def __getattr__(name):
         from mdi_llm_tpu.analysis import ir
 
         return getattr(ir, name)
+    if name in _FLOW_NAMES:
+        from mdi_llm_tpu.analysis import liveness
+
+        return getattr(liveness, name)
+    if name in _CHECK_NAMES:
+        from mdi_llm_tpu.analysis import check
+
+        return getattr(check, name)
     raise AttributeError(name)

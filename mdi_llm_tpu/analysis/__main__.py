@@ -1,6 +1,8 @@
 """`python -m mdi_llm_tpu.analysis` == `mdi-lint`;
 `python -m mdi_llm_tpu.analysis audit ...` == `mdi-audit`;
-`python -m mdi_llm_tpu.analysis ir ...` == `mdi-ir`
+`python -m mdi_llm_tpu.analysis ir ...` == `mdi-ir`;
+`python -m mdi_llm_tpu.analysis flow ...` == `mdi-flow`;
+`python -m mdi_llm_tpu.analysis check ...` == `mdi-check`
 (an explicit leading `lint` is also accepted)."""
 
 import sys
@@ -12,6 +14,14 @@ if argv[:1] == ["audit"]:
     raise SystemExit(main(argv[1:]))
 if argv[:1] == ["ir"]:
     from mdi_llm_tpu.analysis.ir import main
+
+    raise SystemExit(main(argv[1:]))
+if argv[:1] == ["flow"]:
+    from mdi_llm_tpu.analysis.liveness import main
+
+    raise SystemExit(main(argv[1:]))
+if argv[:1] == ["check"]:
+    from mdi_llm_tpu.analysis.check import main
 
     raise SystemExit(main(argv[1:]))
 if argv[:1] == ["lint"]:

@@ -406,8 +406,47 @@ def _sharded_nbytes(leaf, spec, sizes: Dict[str, int]) -> int:
     return int(leaf.nbytes) // denom
 
 
+def _liveness_act_bytes(plan: PlanSpec) -> Optional[int]:
+    """The liveness-derived activation high-water for an engine-enumerable
+    serving plan: the worst executable's interior temp peak from
+    mdi-flow's data-flow pass over the actual jaxprs
+    (analysis/liveness.py), replacing the analytic activation term with a
+    per-executable number.  None when the engine cannot be built
+    abstractly (non-serving plans, non-engine meshes): callers keep the
+    heuristic.  Still backend-free — `trace_serving` enumerates devices,
+    compiles nothing."""
+    if plan.serving is None:
+        return None
+    if any(n not in ("tp", "pp") for n in plan.mesh.names):
+        return None  # dp/ep/pipe plans are not serving-engine-enumerable
+    try:
+        from mdi_llm_tpu.analysis.ir import trace_serving
+        from mdi_llm_tpu.analysis.liveness import analyze_flow
+
+        engine = trace_serving(
+            plan.cfg,
+            plan.serving,
+            tp=plan.mesh.size("tp"),
+            pp=plan.mesh.size("pp"),
+            dtype=plan.dtype,
+            quantize=plan.quantize,
+            max_seq_length=plan.max_seq_length,
+        )
+        _, profiles = analyze_flow(
+            engine.enumerate_executables(), origin=plan.origin
+        )
+    except Exception:
+        return None  # a broken plan audits with the heuristic instead
+    if not profiles:
+        return None
+    return max(p.temp_peak_bytes for p in profiles)
+
+
 def _check_memory(
-    plan: PlanSpec, findings: List[Finding], breakdown: Dict[str, Any]
+    plan: PlanSpec,
+    findings: List[Finding],
+    breakdown: Dict[str, Any],
+    liveness: bool = False,
 ) -> None:
     from mdi_llm_tpu.parallel.partition import stage_layers
     from mdi_llm_tpu.parallel.sharding import adapt_specs_to_tree, param_specs
@@ -523,12 +562,21 @@ def _check_memory(
     act_dev = act_batch * T * (
         4 * cfg.n_embd + cfg.qkv_size + cfg.attn_out_size + mlp_live
     ) * par_item + act_batch * cfg.padded_vocab_size * par_item
+    act_source = "heuristic"
+    if liveness:
+        # engine-enumerable (Config, mesh, ServingConfig) tuples get the
+        # liveness-derived per-executable high-water instead of the
+        # analytic term; everything else keeps the heuristic
+        lv = _liveness_act_bytes(plan)
+        if lv is not None:
+            act_dev, act_source = int(lv), "liveness"
 
     total = params_dev + kv_dev + act_dev
     breakdown["per_device"] = {
         "params_bytes": int(params_dev),
         "kv_bytes": int(kv_dev),
         "act_bytes": int(act_dev),
+        "act_source": act_source,
         "total_bytes": int(total),
     }
     breakdown["n_devices"] = mesh.n_devices
@@ -1034,8 +1082,13 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
 # ---------------------------------------------------------------------------
 
 
-def audit_plan(plan: PlanSpec) -> AuditReport:
-    """Run every checker family; never touches a device or compiles."""
+def audit_plan(plan: PlanSpec, liveness: bool = False) -> AuditReport:
+    """Run every checker family; never touches a device or compiles.
+    `liveness=True` swaps the analytic activation high-water for the
+    mdi-flow liveness-derived per-executable number whenever the plan is
+    serving-engine-enumerable (`_liveness_act_bytes`; heuristic
+    fallback otherwise) — slower (it traces the whole compile set), so
+    opt-in."""
     findings: List[Finding] = []
     breakdown: Dict[str, Any] = {}
     _check_mesh(plan, findings)
@@ -1043,7 +1096,7 @@ def audit_plan(plan: PlanSpec) -> AuditReport:
     _check_sharding(plan, findings)
     _check_serving(plan, findings, breakdown)
     _check_schedule(plan, findings, breakdown)
-    _check_memory(plan, findings, breakdown)
+    _check_memory(plan, findings, breakdown, liveness=liveness)
     order = {code: i for i, code in enumerate(AUDIT_RULES)}
     findings.sort(key=lambda f: (order.get(f.rule, 99), f.message))
     return AuditReport(plan=plan, findings=findings, breakdown=breakdown)
@@ -1068,6 +1121,7 @@ def preflight(
     serving: Optional[ServingConfig] = None,
     hbm_gb: Optional[float] = None,
     origin: str = "<preflight>",
+    liveness: bool = False,
 ) -> AuditReport:
     """Build the PlanSpec an engine launch implies and audit it.  Shared by
     bench.py / mdi-serve / mdi-starter; pure host-side analysis — adds zero
@@ -1104,7 +1158,7 @@ def preflight(
         shard_head=not (pipeline if pipeline is not None else S > 1),
         origin=origin,
     )
-    return audit_plan(plan)
+    return audit_plan(plan, liveness=liveness)
 
 
 def refusal_text(tool: str) -> str:
@@ -1206,6 +1260,12 @@ def build_parser() -> argparse.ArgumentParser:
                      "prefill_chunk)")
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget (e.g. 16 for v5e)")
+    ap.add_argument("--liveness", action="store_true",
+                    help="derive the activation high-water from mdi-flow's "
+                    "buffer-liveness pass over the serving compile set "
+                    "instead of the analytic heuristic (serving plans on "
+                    "tp/pp meshes only; traces every executable, so "
+                    "slower)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="grandfather findings via an mdi-lint-style baseline")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -1308,7 +1368,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (ValueError, OSError, json.JSONDecodeError) as e:
         print(f"mdi-audit: {e}", file=sys.stderr)
         return 2
-    report = audit_plan(plan)
+    report = audit_plan(plan, liveness=args.liveness)
 
     errors = report.errors
     if args.baseline:

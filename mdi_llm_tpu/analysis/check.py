@@ -1,0 +1,295 @@
+"""`mdi-check`: the aggregate analysis gate — lint + audit + ir + flow.
+
+One command that runs every static analyzer the repo ships over one
+(model, mesh, ServingConfig) tuple and the source tree, with unified
+exit codes and a single `--format json` report:
+
+- **lint** (mdi-lint, analysis/core.py + rules): AST rules over the
+  package sources, grandfathered through the committed
+  `.mdi-lint-baseline.json` exactly like bare `mdi-lint`.
+- **audit** (mdi-audit, analysis/audit.py): plan/shape arithmetic for
+  the serving launch the tuple implies — sharding consistency, byte
+  budgets, schedule soundness.
+- **ir** (mdi-ir, analysis/ir.py): abstract traces of every serving
+  executable — compile-set closure, donation marks, IR hygiene.
+- **flow** (mdi-flow, analysis/liveness.py): buffer liveness over the
+  same traced engine — donation aliasing, live-range bloat, static
+  peak-HBM (pinned against goldens/flow-goldens.json when present).
+
+The engine is traced ONCE and shared by the ir and flow passes.  Purely
+host-side: no checkpoint, no backend compile, no device placement — the
+tier-1 self-check test drives this command so all four analyzers stay
+clean in one place.
+
+CLI: ``mdi-check --model pythia-14m`` (or ``python -m
+mdi_llm_tpu.analysis check ...``); ``--tp/--pp``, serving knobs,
+``--hbm-gb``, ``--goldens`` (default: goldens/flow-goldens.json when it
+exists), ``--skip FAMILY`` (repeatable), ``--format json``,
+``--list-checks``.  Exit 0 when every family is clean (modulo the lint
+baseline), 1 on findings, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from mdi_llm_tpu.config import Config, ServingConfig
+
+__all__ = ["FAMILIES", "main", "run_check"]
+
+FAMILIES = ("lint", "audit", "ir", "flow")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mdi-check",
+        description="Aggregate analysis gate: run mdi-lint + mdi-audit + "
+        "mdi-ir + mdi-flow over one (model, mesh, ServingConfig) tuple "
+        "and the source tree, with unified exit codes and one JSON "
+        "report (see docs/analysis.md, 'The aggregate gate (mdi-check)')",
+    )
+    src = ap.add_argument_group("model source")
+    src.add_argument("--model", default=None, help="registry model name")
+    src.add_argument("--config", default=None, metavar="FILE",
+                     help="model_config.yaml / config.json to trace")
+    par = ap.add_argument_group("parallel plan")
+    par.add_argument("--tp", type=int, default=1)
+    par.add_argument("--pp", type=int, default=1)
+    run = ap.add_argument_group("run shape")
+    run.add_argument("--seq-len", type=int, default=None)
+    run.add_argument("--dtype", default="bfloat16",
+                     choices=("bfloat16", "float16", "float32"))
+    run.add_argument("--quantize", default="none",
+                     choices=("none", "int8", "w8a8"))
+    srv = ap.add_argument_group("serving (ServingConfig)")
+    srv.add_argument("--block-size", type=int, default=16)
+    srv.add_argument("--max-batch", type=int, default=8)
+    srv.add_argument("--prefill-chunk", type=int, default=128)
+    srv.add_argument("--token-budget", type=int, default=None)
+    srv.add_argument("--decode-chunk", type=int, default=8)
+    srv.add_argument("--spec-k", type=int, default=0)
+    srv.add_argument("--kv-dtype", default="auto")
+    ap.add_argument("--paths", nargs="*", default=None, metavar="PATH",
+                    help="files/dirs for the lint family (default: the "
+                    "mdi_llm_tpu package next to this file)")
+    ap.add_argument("--lint-baseline", default=None, metavar="FILE",
+                    help="mdi-lint baseline (default: "
+                    "./.mdi-lint-baseline.json when present)")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM budget for the audit and flow "
+                    "families")
+    ap.add_argument("--goldens", default=None, metavar="FILE",
+                    help="flow golden budgets (default: "
+                    "goldens/flow-goldens.json when present)")
+    ap.add_argument("--skip", action="append", default=[],
+                    choices=FAMILIES, metavar="FAMILY",
+                    help="skip one analyzer family; repeatable")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print every family's rule registry and exit")
+    return ap
+
+
+def _list_checks() -> None:
+    from mdi_llm_tpu.analysis.audit import AUDIT_RULES
+    from mdi_llm_tpu.analysis.core import RULES
+    from mdi_llm_tpu.analysis.ir import IR_RULES
+    from mdi_llm_tpu.analysis.liveness import FLOW_RULES
+
+    families = [
+        ("lint", {name: ("error", r.summary) for name, r in RULES.items()}),
+        ("audit", AUDIT_RULES),
+        ("ir", IR_RULES),
+        ("flow", FLOW_RULES),
+    ]
+    for family, rules in families:
+        for code, (sev, summary) in rules.items():
+            print(f"{family}:{code}  [{sev}] {summary}")
+
+
+def run_check(args) -> Dict[str, Any]:
+    """Run the requested families; returns the aggregate report dict
+    (the `--format json` payload).  Raises ValueError on usage
+    problems."""
+    skip = set(args.skip or ())
+    report: Dict[str, Any] = {"families": {}, "errors": 0, "warnings": 0}
+
+    cfg = serving = engine = None
+    need_engine = ("audit" not in skip or "ir" not in skip
+                   or "flow" not in skip)
+    if need_engine:
+        if args.config:
+            cfg = Config.from_file(args.config)
+        elif args.model:
+            cfg = Config.from_name(args.model)
+        else:
+            raise ValueError("need --model or --config (or skip the "
+                             "audit/ir/flow families)")
+        serving = ServingConfig(
+            block_size=args.block_size,
+            max_batch=args.max_batch,
+            prefill_chunk=args.prefill_chunk,
+            token_budget=args.token_budget,
+            decode_chunk=args.decode_chunk,
+            spec_k=args.spec_k,
+            kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
+        )
+    name = args.model or (Path(args.config).stem if args.config else "?")
+    mesh_tag = "".join(
+        t for t in (f"@tp{args.tp}" if args.tp > 1 else "",
+                    f"@pp{args.pp}" if args.pp > 1 else "")
+    )
+    origin = f"{name}{mesh_tag}"
+    report["origin"] = origin
+
+    if "lint" not in skip:
+        from mdi_llm_tpu.analysis.cli import BASELINE_NAME
+        from mdi_llm_tpu.analysis.core import Baseline, lint_paths
+
+        if args.paths:
+            paths = [Path(p) for p in args.paths]
+            root = Path.cwd()
+        else:
+            pkg = Path(__file__).resolve().parent.parent
+            paths, root = [pkg], pkg.parent
+        findings, errors = lint_paths(paths, root=root)
+        base_path = (Path(args.lint_baseline) if args.lint_baseline
+                     else root / BASELINE_NAME)
+        grandfathered = 0
+        if base_path.exists():
+            new, old = Baseline.load(base_path).split(findings)
+            findings, grandfathered = new, len(old)
+        report["families"]["lint"] = {
+            "errors": len(findings) + len(errors),
+            "warnings": 0,
+            "grandfathered": grandfathered,
+            "findings": [
+                f"{f.path}:{f.line}: {f.rule}: {f.message}"
+                for f in findings
+            ] + errors,
+        }
+
+    if "audit" not in skip:
+        from mdi_llm_tpu.analysis.audit import preflight
+
+        audit_report = preflight(
+            cfg,
+            tp=args.tp,
+            pp=args.pp,
+            batch=args.max_batch,
+            seq_len=args.seq_len,
+            act_seq_len=serving.resolved_token_budget(),
+            dtype=args.dtype,
+            quantize=None if args.quantize == "none" else args.quantize,
+            serving=serving,
+            hbm_gb=args.hbm_gb,
+            origin=f"check:{origin}",
+        )
+        report["families"]["audit"] = {
+            "errors": len(audit_report.errors),
+            "warnings": len(audit_report.warnings),
+            "findings": audit_report.render_findings(),
+            "breakdown": audit_report.breakdown,
+        }
+
+    if "ir" not in skip or "flow" not in skip:
+        from mdi_llm_tpu.analysis.ir import trace_serving
+
+        engine = trace_serving(
+            cfg,
+            serving,
+            tp=args.tp,
+            pp=args.pp,
+            dtype=args.dtype,
+            quantize=None if args.quantize == "none" else args.quantize,
+            max_seq_length=args.seq_len,
+        )
+
+    if "ir" not in skip:
+        from mdi_llm_tpu.analysis.ir import ir_preflight
+
+        ir_report = ir_preflight(engine, origin=origin)
+        report["families"]["ir"] = {
+            "errors": len(ir_report.errors),
+            "warnings": len(ir_report.warnings),
+            "findings": ir_report.render_findings(),
+            "executables": {
+                r["name"]: r.get("eqns") for r in ir_report.executables
+            },
+        }
+
+    if "flow" not in skip:
+        from mdi_llm_tpu.analysis.liveness import (
+            DEFAULT_GOLDENS,
+            flow_preflight,
+            load_goldens,
+        )
+
+        goldens = None
+        goldens_path = (Path(args.goldens) if args.goldens
+                        else Path(DEFAULT_GOLDENS))
+        if args.goldens or goldens_path.exists():
+            goldens = load_goldens(goldens_path)  # raises on a bad file
+        flow_report = flow_preflight(
+            engine, origin=origin, hbm_gb=args.hbm_gb, goldens=goldens
+        )
+        report["families"]["flow"] = {
+            "errors": len(flow_report.errors),
+            "warnings": len(flow_report.warnings),
+            "findings": flow_report.render_findings(),
+            "peak_bytes": {
+                p.name: p.peak_bytes for p in flow_report.profiles
+            },
+        }
+
+    report["errors"] = sum(
+        f["errors"] for f in report["families"].values()
+    )
+    report["warnings"] = sum(
+        f["warnings"] for f in report["families"].values()
+    )
+    return report
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    lines = [f"mdi-check: {report.get('origin', '?')}"]
+    for family, res in report["families"].items():
+        status = "clean" if not res["errors"] else f"{res['errors']} error(s)"
+        extra = ""
+        if res.get("warnings"):
+            extra += f", {res['warnings']} warning(s)"
+        if res.get("grandfathered"):
+            extra += f", {res['grandfathered']} grandfathered"
+        lines.append(f"  {family:<6} {status}{extra}")
+        for f in res.get("findings", []):
+            lines.append(f"    {f}")
+    lines.append(
+        "check: " + ("PASS" if not report["errors"]
+                     else f"FAIL ({report['errors']} error(s))")
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checks:
+        _list_checks()
+        return 0
+    try:
+        report = run_check(args)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"mdi-check: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_text(report))
+    return 1 if report["errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -175,6 +175,33 @@ def _aval_nbytes(x) -> int:
     return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
 
 
+def sharding_denom(leaf) -> int:
+    """Mesh-axis product a value's DECLARED sharding divides it by: axes
+    whose size doesn't divide the dim are dropped by the runtime
+    (`adapt_specs_to_tree`) and count whole.  1 for unsharded/opaque
+    values.  Shared with mdi-flow's per-device byte attribution."""
+    sh = getattr(leaf, "sharding", None)
+    pspec = getattr(sh, "spec", None)
+    mesh = getattr(sh, "mesh", None)
+    if pspec is None or mesh is None:
+        return 1
+    try:
+        sizes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except (TypeError, ValueError):
+        return 1
+    shape = getattr(leaf, "shape", ())
+    denom = 1
+    for i, entry in enumerate(tuple(pspec)[: len(shape)]):
+        axes = entry if isinstance(entry, (list, tuple)) else (entry,)
+        for ax in axes:
+            if ax is None:
+                continue
+            s = sizes.get(str(ax), 1)
+            if s > 1 and shape[i] % s == 0:
+                denom *= s
+    return denom
+
+
 def _dtype_name(x) -> str:
     try:
         return np.dtype(getattr(x, "dtype", x)).name
@@ -209,19 +236,25 @@ def _check_callbacks(spec, closed, path: str) -> List[Finding]:
 
 
 def _check_const_bloat(spec, closed, path: str, max_bytes: int) -> List[Finding]:
+    """Threshold (`--const-bytes`) applies to the PER-DEVICE bytes: a
+    constant sharded over tp/pp ships each device only its slice
+    (`sharding_denom`), so sharded tables no longer trip the rule
+    spuriously — unsharded consts count whole, exactly as before."""
     out: List[Finding] = []
     for jaxpr, consts in _iter_jaxprs(closed):
         for c in consts:
-            nb = _aval_nbytes(c)
+            denom = sharding_denom(c)
+            nb = _aval_nbytes(c) // denom
             if nb >= max_bytes:
+                shard = f" per device (/{denom})" if denom > 1 else ""
                 out.append(Finding(
                     rule="baked-constant-bloat", path=path, line=0, col=0,
                     message=(
-                        f"{spec.name} bakes a {nb / 2**20:.1f} MiB "
-                        f"{_dtype_name(c)}{tuple(np.shape(c))} constant into "
-                        f"the jaxpr (threshold {max_bytes / 2**20:.0f} MiB): "
-                        "it ships inside the executable — pass it as an "
-                        "argument instead"
+                        f"{spec.name} bakes a {nb / 2**20:.1f} MiB"
+                        f"{shard} {_dtype_name(c)}{tuple(np.shape(c))} "
+                        "constant into the jaxpr (threshold "
+                        f"{max_bytes / 2**20:.0f} MiB): it ships inside "
+                        "the executable — pass it as an argument instead"
                     ),
                     line_text=(
                         f"const:{_dtype_name(c)}:{tuple(np.shape(c))}"
@@ -729,9 +762,13 @@ def build_parser() -> argparse.ArgumentParser:
     seq.add_argument("--new-tokens", type=int, default=32)
     seq.add_argument("--chunk-size", type=int, default=16)
     seq.add_argument("--speculative", type=int, default=None)
-    ap.add_argument("--max-const-bytes", type=int,
+    ap.add_argument("--const-bytes", "--max-const-bytes",
+                    dest="max_const_bytes", type=int,
                     default=DEFAULT_MAX_CONST_BYTES,
-                    help="baked-constant-bloat threshold (bytes)")
+                    help="baked-constant-bloat threshold in bytes, "
+                    "counted PER DEVICE under tp/pp (sharded constants "
+                    "cost each device only their slice); "
+                    "--max-const-bytes is the deprecated alias")
     ap.add_argument("--no-donation-check", action="store_true",
                     help="skip the .lower()-based dropped-donation rule "
                     "(the slowest rule on big models)")

@@ -1,0 +1,1275 @@
+"""`mdi-flow`: jaxpr buffer-liveness analysis of the serving compile set.
+
+The fifth analysis family, after mdi-lint (source AST), mdi-audit
+(plan/shape arithmetic), mdi-race (thread roles) and mdi-ir (trace
+hygiene): a backend-free data-flow pass over the abstract jaxprs of every
+executable the serving engine can dispatch.  mdi-ir proves WHAT compiles
+(compile-set closure, donation marks, IR hygiene); mdi-flow proves WHAT
+IS LIVE WHEN — per-buffer live ranges through `scan`/`while`/`cond`
+sub-jaxprs and the pp ring's `shard_map` bodies, donation-aware aliasing,
+and a static peak-HBM high-water per executable.  Peak memory today is
+either a heuristic (mdi-audit's analytic activation term) or observed
+only after a real XLA compile (`memory_analysis`), so a live-range or
+donation regression ships silently and surfaces as an OOM on hardware we
+rarely have; this pass makes the byte claims provable in CI with zero
+backend compiles and zero device transfers (only `jitted.trace(...)`
+over `ShapeDtypeStruct`s — it never even `.lower()`s).
+
+The static model mirrors XLA's `memory_analysis` accounting
+(args + outputs + temps − donation aliases) so it can be CALIBRATED, not
+just plausible:
+
+- **arguments / outputs** — summed over the flat jaxpr invars/outvars;
+  donated inputs greedily matched to outputs by (shape, dtype) dedupe as
+  `alias_bytes` exactly like XLA's input-output aliasing.
+- **temps** — a def/last-use liveness sweep over every equation:
+  interior values (neither invars nor outvars) contribute bytes from
+  their defining equation to their last read; a nested jaxpr (scan body,
+  while/cond branch, pjit call, shard_map region) contributes its OWN
+  interior peak at the enclosing equation's program point — one
+  allocation per body, matching XLA's loop-body buffer reuse.
+- **per-device attribution** — input/output leaves divide by the mesh
+  axis sizes their declared sharding actually divides (the kv pool's
+  `NamedSharding` rides on the `ShapeDtypeStruct`s; params scale by the
+  Megatron `param_specs` fraction); `shard_map` interiors are already
+  per-shard by construction; other interiors are counted whole —
+  conservative, never optimistic.
+
+The calibration test (tests/test_flow.py) compiles the real mixed and
+decode_chunk executables on CPU and pins the static high-water within a
+CI tolerance of XLA's own `memory_analysis` — in float32, because the
+CPU backend materializes f32 upcasts of bf16 params (an emulation
+artifact TPUs don't have).
+
+Rules (FLOW_RULES):
+
+- **missed-donation** [warning] — a large (>= `--min-bytes`) non-donated
+  input whose (shape, dtype) matches an output no donated buffer aliases:
+  donating it would drop a whole buffer from the high-water.
+- **live-range-bloat** [warning] — a large buffer threaded through a
+  `scan`/`while`/`cond`/`shard_map` whose body never reads it: the
+  extending site (primitive + equation) holds it live across every
+  iteration for nothing — dead carry/operand payload.
+- **hbm-over-budget** [error] — the engine's per-device static
+  high-water (params + paged pool via the byte-exact `ServingConfig`
+  formulas, plus the worst executable's live temps) exceeds `--hbm-gb`.
+- **peak-memory-regression** [error] — an executable's static peak grew
+  beyond the committed golden budget (goldens/flow-goldens.json) by more
+  than the tolerance; `--update-goldens` re-baselines deliberately.
+- **jaxpr-drift** [warning] — an executable's canonicalized jaxpr digest
+  no longer matches the committed golden; the finding carries an
+  op-level diff (primitive-count deltas) so silent IR churn becomes a
+  reviewable artifact.
+- **trace-failure** [error] — an enumerated executable refused to trace
+  abstractly; no liveness claim can be made about it.
+
+CLI: ``mdi-flow --model pythia-14m --tp 2`` (or ``python -m
+mdi_llm_tpu.analysis flow ...``); ``--hbm-gb``, ``--goldens`` /
+``--update-goldens``, ``--min-bytes``, ``--format json``, ``--baseline``
+/ ``--update-baseline``, ``--suppress RULE=justification``,
+``--list-checks``.  Exit 0 clean, 1 on findings, 2 on usage errors.
+Wired as a bench / mdi-serve preflight via `flow_preflight` +
+`enforce_flow_preflight` (`detail.liveness` per serve row), and into the
+`mdi-check` aggregate gate.  See docs/analysis.md, "Buffer liveness
+(mdi-flow)".
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mdi_llm_tpu.analysis.core import Baseline, Finding
+from mdi_llm_tpu.analysis.ir import (
+    _iter_jaxprs,
+    sharding_denom,
+    trace_serving,
+)
+from mdi_llm_tpu.config import Config, ServingConfig
+
+__all__ = [
+    "FLOW_RULES",
+    "ExecProfile",
+    "FlowReport",
+    "analyze_flow",
+    "enforce_flow_preflight",
+    "flow_detail",
+    "flow_preflight",
+    "jaxpr_digest",
+    "load_goldens",
+    "main",
+    "profile_executable",
+    "write_goldens",
+]
+
+ERROR, WARNING = "error", "warning"
+
+# rule -> (severity, one-line summary); --list-checks prints this
+FLOW_RULES: Dict[str, Tuple[str, str]] = {
+    "missed-donation": (WARNING, (
+        "a large non-donated input's (shape, dtype) matches an un-aliased "
+        "output: donating it would drop one whole buffer from the static "
+        "high-water"
+    )),
+    "live-range-bloat": (WARNING, (
+        "a large buffer is threaded through a scan/while/cond/shard_map "
+        "whose body never reads it: the extending site holds it live "
+        "across every iteration as dead payload"
+    )),
+    "hbm-over-budget": (ERROR, (
+        "the per-device static high-water (params + pool + worst "
+        "executable's live temps) exceeds the --hbm-gb budget"
+    )),
+    "peak-memory-regression": (ERROR, (
+        "an executable's static peak grew beyond its committed golden "
+        "budget by more than the tolerance (--update-goldens re-baselines "
+        "deliberately)"
+    )),
+    "jaxpr-drift": (WARNING, (
+        "an executable's canonical jaxpr digest drifted from the "
+        "committed golden; the finding carries the op-level diff"
+    )),
+    "trace-failure": (ERROR, (
+        "an enumerated executable refused to trace abstractly — no "
+        "liveness claim can be made about it"
+    )),
+}
+
+DEFAULT_MIN_BYTES = 1 * 1024 * 1024  # missed-donation / live-range-bloat
+# floor: engine control operands (tables, positions, keys) sit far below
+# 1 MiB; params and pool leaves sit far above
+DEFAULT_GOLDEN_TOLERANCE = 0.10  # peak-memory-regression trip point
+GiB = float(1024**3)
+
+DEFAULT_GOLDENS = Path("goldens") / "flow-goldens.json"  # repo-root relative
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+# ---------------------------------------------------------------------------
+# byte accounting over avals
+# ---------------------------------------------------------------------------
+
+
+def _itemsize(dtype) -> int:
+    try:
+        return np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtypes (jax PRNG key<fry> etc.) refuse np.dtype; their
+        # physical layout is a pair of uint32s
+        return int(getattr(dtype, "itemsize", None) or 8)
+
+
+def _aval_nbytes(v) -> int:
+    aval = getattr(v, "aval", v)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * _itemsize(dtype)
+
+
+def _aval_sig(v) -> Tuple[Tuple[int, ...], str]:
+    aval = getattr(v, "aval", v)
+    return tuple(getattr(aval, "shape", ())), str(getattr(aval, "dtype", "?"))
+
+
+def _fmt_bytes(n: int) -> str:
+    return f"{n / 2**20:.1f} MiB" if n >= 2**20 else f"{n} B"
+
+
+def _fmt_sig(v) -> str:
+    shape, dtype = _aval_sig(v)
+    return f"{dtype}{shape}"
+
+
+def _is_var(v) -> bool:
+    """True for jaxpr Vars (things with a live range); Literals and
+    DropVars have none."""
+    name = type(v).__name__
+    return name not in ("Literal", "DropVar") and hasattr(v, "aval")
+
+
+# ---------------------------------------------------------------------------
+# liveness sweep
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(eqn) -> List[Any]:
+    """The inner Jaxpr objects of one equation (scan/while/cond bodies,
+    pjit calls, shard_map regions, custom_* rules) — duck-typed like
+    ir._iter_jaxprs, so no jax-internal imports."""
+    out: List[Any] = []
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for v in vals:
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                out.append(inner)
+            elif hasattr(v, "eqns"):
+                out.append(v)
+    return out
+
+
+def interior_peak_bytes(jaxpr) -> int:
+    """Peak bytes of equation-defined temporaries live at any program
+    point of `jaxpr`, nested jaxprs contributing their own interior peak
+    at the enclosing equation's point (one allocation per loop body —
+    XLA reuses body buffers across iterations).  This jaxpr's
+    invars/constvars/outvars are excluded: the caller accounts for them
+    (as arguments/outputs at the top level, as operands one level up
+    otherwise)."""
+    eqns = list(jaxpr.eqns)
+    n = len(eqns)
+    if n == 0:
+        return 0
+    outset = {id(v) for v in jaxpr.outvars if _is_var(v)}
+    defpt: Dict[int, int] = {}
+    lastuse: Dict[int, int] = {}
+    var_bytes: Dict[int, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            if not _is_var(v):
+                continue
+            defpt[id(v)] = i
+            var_bytes[id(v)] = _aval_nbytes(v)
+        for v in eqn.invars:
+            if _is_var(v) and id(v) in defpt:
+                lastuse[id(v)] = i
+    for vid, d in defpt.items():
+        lastuse.setdefault(vid, d)
+    inner = [
+        sum(interior_peak_bytes(j) for j in _sub_jaxprs(e)) for e in eqns
+    ]
+    delta = [0] * (n + 1)
+    for vid, d in defpt.items():
+        if vid in outset:
+            continue  # an output, not a temp — the caller counts it
+        delta[d] += var_bytes[vid]
+        delta[lastuse[vid] + 1] -= var_bytes[vid]
+    peak = cur = 0
+    for i in range(n):
+        cur += delta[i]
+        peak = max(peak, cur + inner[i])
+    return peak
+
+
+# ---------------------------------------------------------------------------
+# donation aliasing
+# ---------------------------------------------------------------------------
+
+
+def _flat_arg_meta(spec) -> Tuple[List[Any], List[int], List[Optional[str]]]:
+    """Flatten `spec.args` to leaves aligned with the jaxpr's flat invars.
+    Returns (leaves, argnum-per-leaf, role-per-leaf)."""
+    import jax
+
+    leaves: List[Any] = []
+    argnums: List[int] = []
+    roles: List[Optional[str]] = []
+    role_map = dict(getattr(spec, "roles", None) or {})
+    for argnum, arg in enumerate(spec.args):
+        for leaf in jax.tree_util.tree_leaves(arg):
+            leaves.append(leaf)
+            argnums.append(argnum)
+            roles.append(role_map.get(argnum))
+    return leaves, argnums, roles
+
+
+def _alias_matching(
+    jaxpr, donate: Sequence[int], argnums: List[int]
+) -> Tuple[int, List[int], List[bool]]:
+    """Greedily match donated input leaves to outputs by (shape, dtype) —
+    the same dedupe XLA's input-output aliasing performs.  Returns
+    (alias_bytes, per-invar alias bytes, per-outvar matched flags).
+    Pass-through outvars (an outvar that IS an invar) are skipped on both
+    sides: aliasing them frees nothing."""
+    invars = list(jaxpr.invars)
+    in_ids = {id(v) for v in invars}
+    matched_out = [False] * len(jaxpr.outvars)
+    avail: Dict[Tuple[Tuple[int, ...], str], List[int]] = {}
+    for j, ov in enumerate(jaxpr.outvars):
+        if not _is_var(ov) or id(ov) in in_ids:
+            matched_out[j] = True  # pass-through: not an alias target
+            continue
+        avail.setdefault(_aval_sig(ov), []).append(j)
+    alias_per_invar = [0] * len(invars)
+    donate_set = set(int(d) for d in donate or ())
+    total = 0
+    for i, iv in enumerate(invars):
+        if i >= len(argnums) or argnums[i] not in donate_set:
+            continue
+        slots = avail.get(_aval_sig(iv))
+        if slots:
+            j = slots.pop(0)
+            matched_out[j] = True
+            alias_per_invar[i] = _aval_nbytes(iv)
+            total += alias_per_invar[i]
+    return total, alias_per_invar, matched_out
+
+
+# ---------------------------------------------------------------------------
+# rules over one executable
+# ---------------------------------------------------------------------------
+
+
+def _check_missed_donation(
+    spec, jaxpr, argnums, matched_out, path: str, min_bytes: int
+) -> List[Finding]:
+    """Non-donated inputs >= min_bytes whose signature matches an output
+    that no donated buffer already aliases."""
+    avail: Dict[Tuple[Tuple[int, ...], str], int] = {}
+    for j, ov in enumerate(jaxpr.outvars):
+        if not matched_out[j] and _is_var(ov):
+            sig = _aval_sig(ov)
+            avail[sig] = avail.get(sig, 0) + 1
+    if not avail:
+        return []
+    donate_set = set(int(d) for d in spec.donate or ())
+    out: List[Finding] = []
+    for i, iv in enumerate(jaxpr.invars):
+        if i < len(argnums) and argnums[i] in donate_set:
+            continue
+        nb = _aval_nbytes(iv)
+        if nb < min_bytes:
+            continue
+        sig = _aval_sig(iv)
+        if avail.get(sig, 0) <= 0:
+            continue
+        avail[sig] -= 1
+        argn = argnums[i] if i < len(argnums) else i
+        out.append(Finding(
+            rule="missed-donation", path=path, line=0, col=0,
+            message=(
+                f"{spec.name} takes a {_fmt_bytes(nb)} {_fmt_sig(iv)} "
+                f"input (argnum {argn}) and returns a same-signature "
+                "output without donating it: both copies stay live — add "
+                f"argnum {argn} to donate_argnums to drop "
+                f"{_fmt_bytes(nb)} from the high-water"
+            ),
+            line_text=f"missed-donation:{argn}:{_fmt_sig(iv)}",
+        ))
+    return out
+
+
+_LOOP_PRIMS = ("scan", "while", "cond", "shard_map", "pjit")
+
+
+def _loop_bindings(eqn) -> List[Tuple[Any, List[Any]]]:
+    """Map each outer operand of a structured-control equation to the
+    inner invars that receive it, per the primitive's binding rule.
+    Returns [] for primitives we don't model (nothing is flagged)."""
+    prim = eqn.primitive.name
+    try:
+        if prim == "scan":
+            inner = eqn.params["jaxpr"].jaxpr.invars
+            if len(inner) != len(eqn.invars):
+                return []
+            return [(ov, [iv]) for ov, iv in zip(eqn.invars, inner)]
+        if prim == "while":
+            cc = int(eqn.params["cond_nconsts"])
+            bc = int(eqn.params["body_nconsts"])
+            cond = eqn.params["cond_jaxpr"].jaxpr.invars
+            body = eqn.params["body_jaxpr"].jaxpr.invars
+            out: List[Tuple[Any, List[Any]]] = []
+            for i, ov in enumerate(eqn.invars):
+                if i < cc:
+                    out.append((ov, [cond[i]]))
+                elif i < cc + bc:
+                    out.append((ov, [body[i - cc]]))
+                else:
+                    j = i - cc - bc
+                    out.append((ov, [cond[cc + j], body[bc + j]]))
+            return out
+        if prim == "cond":
+            branches = eqn.params["branches"]
+            operands = eqn.invars[1:]  # invars[0] is the branch index
+            if any(
+                len(b.jaxpr.invars) != len(operands) for b in branches
+            ):
+                return []
+            return [
+                (ov, [b.jaxpr.invars[j] for b in branches])
+                for j, ov in enumerate(operands)
+            ]
+        if prim in ("shard_map", "pjit"):
+            inner = eqn.params["jaxpr"].jaxpr.invars
+            if len(inner) != len(eqn.invars):
+                return []
+            return [(ov, [iv]) for ov, iv in zip(eqn.invars, inner)]
+    except (KeyError, AttributeError, TypeError):
+        return []
+    return []
+
+
+def _inner_used_ids(jaxprs: List[Any]) -> set:
+    """ids of vars READ by at least one equation of the given jaxprs (a
+    pass-through carry — invar straight to outvar — does not count as a
+    read: that is exactly the dead-payload shape live-range-bloat
+    flags)."""
+    used: set = set()
+    for j in jaxprs:
+        for eqn in j.eqns:
+            for v in eqn.invars:
+                if _is_var(v):
+                    used.add(id(v))
+    return used
+
+
+def _check_live_range_bloat(
+    spec, closed, path: str, min_bytes: int
+) -> List[Finding]:
+    out: List[Finding] = []
+    seen: set = set()
+    for jaxpr, _ in _iter_jaxprs(closed):
+        for idx, eqn in enumerate(jaxpr.eqns):
+            if eqn.primitive.name not in _LOOP_PRIMS:
+                continue
+            bindings = _loop_bindings(eqn)
+            if not bindings:
+                continue
+            used = _inner_used_ids(_sub_jaxprs(eqn))
+            for ov, inner_vars in bindings:
+                if not _is_var(ov):
+                    continue
+                nb = _aval_nbytes(ov)
+                if nb < min_bytes:
+                    continue
+                if any(id(iv) in used for iv in inner_vars):
+                    continue
+                key = (id(eqn), id(ov))
+                if key in seen:
+                    continue
+                seen.add(key)
+                prim = eqn.primitive.name
+                out.append(Finding(
+                    rule="live-range-bloat", path=path, line=0, col=0,
+                    message=(
+                        f"{spec.name} threads a {_fmt_bytes(nb)} "
+                        f"{_fmt_sig(ov)} buffer through `{prim}` (eqn "
+                        f"#{idx}) whose body never reads it: the {prim} "
+                        "holds it live across every iteration as dead "
+                        "carry/operand payload — drop it from the "
+                        "operands"
+                    ),
+                    line_text=f"bloat:{prim}:{_fmt_sig(ov)}",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# canonical digests (golden jaxpr hashes)
+# ---------------------------------------------------------------------------
+
+
+def jaxpr_digest(closed) -> Tuple[str, Dict[str, int]]:
+    """(canonical digest, primitive-name counts) for a ClosedJaxpr.  The
+    digest hashes the jaxpr's pretty-printed form with memory addresses
+    scrubbed (function reprs inside custom_jvp/callback params embed
+    `0x...`), so it is stable across processes; the op counts feed the
+    human-reviewable diff when a golden digest drifts."""
+    text = _ADDR_RE.sub("0x~", str(getattr(closed, "jaxpr", closed)))
+    digest = hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:16]
+    ops: Dict[str, int] = {}
+    for jaxpr, _ in _iter_jaxprs(closed):
+        for eqn in jaxpr.eqns:
+            ops[eqn.primitive.name] = ops.get(eqn.primitive.name, 0) + 1
+    return digest, ops
+
+
+def _op_diff(golden: Dict[str, int], current: Dict[str, int]) -> str:
+    deltas = []
+    for op in sorted(set(golden) | set(current)):
+        d = current.get(op, 0) - golden.get(op, 0)
+        if d:
+            deltas.append(f"{'+' if d > 0 else ''}{d} {op}")
+    return ", ".join(deltas) if deltas else "op counts unchanged"
+
+
+# ---------------------------------------------------------------------------
+# per-device attribution
+# ---------------------------------------------------------------------------
+
+
+_sharding_denom = sharding_denom  # shared with mdi-ir (analysis/ir.py)
+
+
+def _params_device_fraction(gen) -> Optional[float]:
+    """Per-device fraction of the param bytes under the generator's mesh
+    (Megatron `param_specs` adapted to the storage tree — the same
+    arithmetic mdi-audit budgets with).  None when there is no mesh or
+    the spec tree doesn't cover the params (callers then fall back to
+    whole-leaf counting)."""
+    mesh = getattr(gen, "mesh", None)
+    if mesh is None:
+        return None
+    try:
+        sizes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        if all(s <= 1 for s in sizes.values()):
+            return None
+        from mdi_llm_tpu.analysis.audit import _sharded_nbytes
+        from mdi_llm_tpu.analysis.plan import iter_leaves
+        from mdi_llm_tpu.parallel.sharding import (
+            adapt_specs_to_tree,
+            param_specs,
+        )
+
+        tp_axis = "tp" if sizes.get("tp", 1) > 1 else None
+        specs = adapt_specs_to_tree(
+            param_specs(gen.cfg, tp_axis=tp_axis), gen.params,
+            axis_sizes=sizes,
+        )
+        pairs = [
+            (leaf, spec)
+            for (_, leaf), (_, spec) in zip(
+                iter_leaves(gen.params), iter_leaves(specs)
+            )
+        ]
+        total = sum(int(leaf.nbytes) for leaf, _ in pairs)
+        if not total:
+            return None
+        dev = sum(
+            _sharded_nbytes(leaf, spec if spec is not None else (), sizes)
+            for leaf, spec in pairs
+        )
+        return dev / total
+    except Exception:
+        return None  # conservative: count params whole per device
+
+
+# ---------------------------------------------------------------------------
+# one executable's profile
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ExecProfile:
+    """The liveness profile of ONE executable: the static byte model
+    (args + outputs − aliases + interior temp peak, global and
+    per-device) plus the canonical jaxpr digest."""
+
+    name: str
+    label: str
+    key: Tuple
+    argument_bytes: int
+    output_bytes: int
+    alias_bytes: int
+    temp_peak_bytes: int
+    device_argument_bytes: int
+    device_output_bytes: int
+    device_alias_bytes: int
+    digest: str
+    ops: Dict[str, int]
+    eqns: int
+
+    @property
+    def peak_bytes(self) -> int:
+        return (self.argument_bytes + self.output_bytes
+                - self.alias_bytes + self.temp_peak_bytes)
+
+    @property
+    def device_peak_bytes(self) -> int:
+        # interior temps are counted whole per device (shard_map bodies
+        # are already per-shard; GSPMD-partitioned interiors are not
+        # statically attributable — conservative, never optimistic)
+        return (self.device_argument_bytes + self.device_output_bytes
+                - self.device_alias_bytes + self.temp_peak_bytes)
+
+    def as_record(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "label": self.label, "key": list(self.key),
+            "eqns": self.eqns,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "alias_bytes": self.alias_bytes,
+            "temp_peak_bytes": self.temp_peak_bytes,
+            "peak_bytes": self.peak_bytes,
+            "device_peak_bytes": self.device_peak_bytes,
+            "digest": self.digest,
+        }
+
+
+def profile_executable(
+    spec,
+    closed=None,
+    params_fraction: Optional[float] = None,
+) -> ExecProfile:
+    """Build the liveness profile of one `ExecutableSpec` from its
+    (already traced, or traced here) closed jaxpr.  Pure host-side jaxpr
+    arithmetic: no lowering, no backend, no devices."""
+    if closed is None:
+        closed = spec.fn.trace(*spec.args, **(spec.static_kwargs or {})).jaxpr
+    jaxpr = closed.jaxpr
+    leaves, argnums, roles = _flat_arg_meta(spec)
+    if len(leaves) != len(jaxpr.invars):  # defensive: stay total
+        leaves = list(jaxpr.invars)
+        argnums = list(range(len(leaves)))
+        roles = [None] * len(leaves)
+    args_b = sum(_aval_nbytes(v) for v in jaxpr.invars)
+    out_b = sum(_aval_nbytes(v) for v in jaxpr.outvars)
+    alias_b, alias_per_invar, matched_out = _alias_matching(
+        jaxpr, spec.donate or (), argnums
+    )
+    dev_args = dev_alias = 0
+    for i, iv in enumerate(jaxpr.invars):
+        nb = _aval_nbytes(iv)
+        denom = _sharding_denom(leaves[i]) if i < len(leaves) else 1
+        if denom > 1:
+            dnb = nb // denom
+        elif (i < len(roles) and roles[i] == "params"
+              and params_fraction is not None):
+            dnb = int(nb * params_fraction)
+        else:
+            dnb = nb
+        dev_args += dnb
+        if alias_per_invar[i]:
+            dev_alias += dnb
+    dev_out = 0
+    out_in_ids = {id(v): i for i, v in enumerate(jaxpr.invars)}
+    for j, ov in enumerate(jaxpr.outvars):
+        nb = _aval_nbytes(ov)
+        # an output aliased from a donated input shards like the input;
+        # other outputs divide by their own declared sharding if the
+        # aval carries one (it usually doesn't — counted whole)
+        i = out_in_ids.get(id(ov))
+        denom = _sharding_denom(leaves[i]) if i is not None and i < len(
+            leaves
+        ) else 1
+        dev_out += nb // denom if denom > 1 else nb
+    temp_peak = interior_peak_bytes(jaxpr)
+    digest, ops = jaxpr_digest(closed)
+    return ExecProfile(
+        name=spec.name, label=spec.label, key=tuple(spec.key),
+        argument_bytes=int(args_b), output_bytes=int(out_b),
+        alias_bytes=int(alias_b), temp_peak_bytes=int(temp_peak),
+        device_argument_bytes=int(dev_args),
+        device_output_bytes=int(dev_out),
+        device_alias_bytes=int(dev_alias),
+        digest=digest, ops=ops,
+        eqns=sum(len(j.eqns) for j, _ in _iter_jaxprs(closed)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# goldens (budgets + digests)
+# ---------------------------------------------------------------------------
+
+
+def load_goldens(path: Path) -> Dict[str, Any]:
+    data = json.loads(Path(path).read_text())
+    if not isinstance(data, dict) or "budgets" not in data:
+        raise ValueError(f"{path}: not a flow goldens file (no 'budgets')")
+    return data
+
+
+def write_goldens(
+    path: Path,
+    origin: str,
+    profiles: Sequence[ExecProfile],
+    tolerance: float = DEFAULT_GOLDEN_TOLERANCE,
+) -> Dict[str, Any]:
+    """Merge this origin's budgets/digests into the goldens file (other
+    origins' entries are preserved — the file accumulates the registry
+    models' compile set one `--update-goldens` run at a time)."""
+    path = Path(path)
+    try:
+        data = load_goldens(path)
+    except (OSError, ValueError, json.JSONDecodeError):
+        data = {"version": 1, "tolerance": tolerance, "budgets": {}}
+    for p in profiles:
+        data["budgets"][f"{origin}::{p.name}"] = {
+            "peak_bytes": p.peak_bytes,
+            "device_peak_bytes": p.device_peak_bytes,
+            "digest": p.digest,
+            "ops": dict(sorted(p.ops.items())),
+        }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+    return data
+
+
+def _check_goldens(
+    profiles: Sequence[ExecProfile],
+    goldens: Dict[str, Any],
+    origin: str,
+    tolerance: Optional[float] = None,
+) -> List[Finding]:
+    tol = tolerance if tolerance is not None else float(
+        goldens.get("tolerance", DEFAULT_GOLDEN_TOLERANCE)
+    )
+    budgets = goldens.get("budgets", {})
+    out: List[Finding] = []
+    for p in profiles:
+        key = f"{origin}::{p.name}"
+        entry = budgets.get(key)
+        if entry is None:
+            continue  # no committed budget for this tuple — nothing to pin
+        path = f"{origin}::{p.name}"
+        golden_peak = int(entry.get("peak_bytes", 0))
+        if golden_peak and p.peak_bytes > golden_peak * (1 + tol):
+            grew = p.peak_bytes / golden_peak - 1
+            out.append(Finding(
+                rule="peak-memory-regression", path=path, line=0, col=0,
+                message=(
+                    f"{p.name} static peak {_fmt_bytes(p.peak_bytes)} is "
+                    f"{grew:+.1%} over its golden budget "
+                    f"{_fmt_bytes(golden_peak)} (tolerance {tol:.0%}): a "
+                    "live-range or donation regression — fix it, or "
+                    "re-baseline deliberately with --update-goldens"
+                ),
+                line_text=f"regression:{p.name}",
+            ))
+        golden_digest = entry.get("digest")
+        if golden_digest and golden_digest != p.digest:
+            diff = _op_diff(entry.get("ops", {}), p.ops)
+            out.append(Finding(
+                rule="jaxpr-drift", path=path, line=0, col=0,
+                message=(
+                    f"{p.name} canonical jaxpr digest {p.digest} != "
+                    f"golden {golden_digest}; op-level diff: {diff} "
+                    "(review the IR churn, then --update-goldens)"
+                ),
+                line_text=f"drift:{p.name}",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def analyze_flow(
+    specs: Sequence[Any],
+    origin: str = "<specs>",
+    min_bytes: int = DEFAULT_MIN_BYTES,
+    params_fraction: Optional[float] = None,
+) -> Tuple[List[Finding], List[ExecProfile]]:
+    """Trace every `ExecutableSpec`, build its liveness profile, and run
+    the per-executable rules (missed-donation, live-range-bloat).
+    Returns (findings, profiles)."""
+    findings: List[Finding] = []
+    profiles: List[ExecProfile] = []
+    for spec in specs:
+        path = f"{origin}::{spec.name}"
+        try:
+            closed = spec.fn.trace(
+                *spec.args, **(spec.static_kwargs or {})
+            ).jaxpr
+        except Exception as e:
+            findings.append(Finding(
+                rule="trace-failure", path=path, line=0, col=0,
+                message=f"{spec.name} failed to trace abstractly: {e}",
+                line_text="trace",
+            ))
+            continue
+        profile = profile_executable(
+            spec, closed, params_fraction=params_fraction
+        )
+        profiles.append(profile)
+        leaves, argnums, _roles = _flat_arg_meta(spec)
+        if len(leaves) != len(closed.jaxpr.invars):
+            argnums = list(range(len(closed.jaxpr.invars)))
+        _, _, matched_out = _alias_matching(
+            closed.jaxpr, spec.donate or (), argnums
+        )
+        findings += _check_missed_donation(
+            spec, closed.jaxpr, argnums, matched_out, path, min_bytes
+        )
+        findings += _check_live_range_bloat(spec, closed, path, min_bytes)
+    return findings, profiles
+
+
+@dataclasses.dataclass
+class FlowReport:
+    """One mdi-flow pass: findings + the per-executable liveness
+    profiles."""
+
+    origin: str
+    findings: List[Finding]
+    profiles: List[ExecProfile]
+    breakdown: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    suppressed: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def severity(self, f: Finding) -> str:
+        return FLOW_RULES.get(f.rule, (ERROR, ""))[0]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if self.severity(f) == ERROR]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if self.severity(f) == WARNING]
+
+    def suppress(self, reasons: Dict[str, str]) -> None:
+        keep: List[Finding] = []
+        for f in self.findings:
+            reason = reasons.get(f.rule)
+            if reason:
+                self.suppressed.append({
+                    "rule": f.rule, "path": f.path, "message": f.message,
+                    "justification": reason,
+                })
+            else:
+                keep.append(f)
+        self.findings = keep
+
+    def render_findings(self) -> List[str]:
+        return [
+            f"{f.path}: {self.severity(f)}: {f.rule}: {f.message}"
+            for f in self.findings
+        ]
+
+    def render_text(self) -> str:
+        lines = [f"liveness: {self.origin}"]
+        for p in self.profiles:
+            lines.append(
+                f"  {p.name:<24} peak={p.peak_bytes / 2**20:8.1f} MiB  "
+                f"(args={p.argument_bytes / 2**20:.1f} "
+                f"out={p.output_bytes / 2**20:.1f} "
+                f"alias=-{p.alias_bytes / 2**20:.1f} "
+                f"temps={p.temp_peak_bytes / 2**20:.1f})  "
+                f"dev={p.device_peak_bytes / 2**20:.1f} MiB  "
+                f"digest={p.digest}"
+            )
+        dev = self.breakdown.get("per_device")
+        if dev:
+            lines.append(
+                f"  per-device high-water: "
+                f"{dev['high_water_bytes'] / 2**20:.1f} MiB "
+                f"(params {dev['params_bytes'] / 2**20:.1f} + pool "
+                f"{dev['pool_bytes'] / 2**20:.1f} + worst-exec "
+                f"temps/operands, at {dev['worst_executable']})"
+            )
+        if self.findings:
+            lines.extend(self.render_findings())
+        else:
+            lines.append("findings: none")
+        for s in self.suppressed:
+            lines.append(
+                f"suppressed: {s['rule']} ({s['justification']}): "
+                f"{s['message']}"
+            )
+        return "\n".join(lines)
+
+    def as_json(self) -> Dict[str, Any]:
+        return {
+            "origin": self.origin,
+            "executables": [p.as_record() for p in self.profiles],
+            "breakdown": self.breakdown,
+            "findings": [
+                {**f.__dict__, "severity": self.severity(f)}
+                for f in self.findings
+            ],
+            "suppressed": self.suppressed,
+            "errors": len(self.errors),
+            "warnings": len(self.warnings),
+        }
+
+
+def _check_hbm_budget(
+    engine,
+    profiles: Sequence[ExecProfile],
+    origin: str,
+    hbm_gb: float,
+    breakdown: Dict[str, Any],
+) -> List[Finding]:
+    """Per-device static high-water vs the HBM budget: params + paged
+    pool via the byte-exact ServingConfig formulas, plus the worst
+    executable's remaining per-device live bytes (operands beyond
+    params/pool, un-aliased outputs, interior temp peak)."""
+    gen = engine.gen
+    cfg = gen.cfg
+    serving: ServingConfig = engine.cfg
+    fraction = _params_device_fraction(gen)
+    params_total = sum(
+        int(getattr(leaf, "nbytes", 0) or _aval_nbytes(leaf))
+        for leaf in _tree_leaves(gen.params)
+    )
+    params_dev = int(params_total * (fraction if fraction else 1.0))
+    mesh = getattr(gen, "mesh", None)
+    sizes = (
+        {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        if mesh is not None else {}
+    )
+    tp = sizes.get("tp", 1)
+    pp = sizes.get("pp", 1)
+    try:
+        pool_dev = serving.pool_bytes_per_device(
+            cfg, tp, gen.max_seq_length,
+            serving.resolved_kv_dtype(str(np.dtype(gen.cache_dtype))),
+        )
+    except (AttributeError, TypeError, ValueError):
+        try:
+            pool_dev = serving.pool_bytes(cfg, gen.max_seq_length) // max(
+                1, tp
+            )
+        except ValueError:
+            pool_dev = 0
+    if pp > 1 and cfg.n_layer >= pp:
+        from mdi_llm_tpu.parallel.partition import stage_layers
+
+        pool_dev = pool_dev // cfg.n_layer * max(
+            stage_layers(cfg.n_layer, pp)
+        )
+        params_dev = _pp_params_device_bytes(gen, params_dev, pp)
+    worst = None
+    worst_rest = 0
+    for p in profiles:
+        # the profile's device peak already contains params+pool (they
+        # ride in as arguments); take everything BEYOND them so the
+        # formula-exact params/pool numbers anchor the budget line
+        rest = max(
+            0, p.device_peak_bytes - int(params_total * (
+                fraction if fraction else 1.0
+            )) - (p.device_alias_bytes or 0)
+        )
+        if worst is None or rest > worst_rest:
+            worst, worst_rest = p, rest
+    high_water = params_dev + pool_dev + worst_rest
+    breakdown["per_device"] = {
+        "params_bytes": int(params_dev),
+        "pool_bytes": int(pool_dev),
+        "high_water_bytes": int(high_water),
+        "worst_executable": worst.name if worst else None,
+    }
+    budget = int(float(hbm_gb) * GiB)
+    breakdown["budget_bytes"] = budget
+    if high_water <= budget:
+        return []
+    return [Finding(
+        rule="hbm-over-budget", path=f"{origin}::budget", line=0, col=0,
+        message=(
+            f"per-device static high-water {high_water / GiB:.2f} GiB "
+            f"exceeds the {float(hbm_gb):g} GiB budget (params "
+            f"{params_dev / GiB:.2f} + pool {pool_dev / GiB:.2f} + "
+            f"{worst_rest / GiB:.2f} live at "
+            f"{worst.name if worst else '?'}): shrink the pool "
+            "(max_blocks / kv_dtype=int8), the batch, or the window — "
+            "or raise --hbm-gb if the budget was wrong"
+        ),
+        line_text="hbm-over-budget",
+    )]
+
+
+def _pp_params_device_bytes(gen, params_dev: int, pp: int) -> int:
+    """Per-stage params under pipelined serving: each device holds l_max
+    zero-padded layer slots of the blocks plus the replicated
+    embeddings/norm/head (mirrors mdi-audit's pipeline budget)."""
+    try:
+        from mdi_llm_tpu.analysis.plan import iter_leaves
+        from mdi_llm_tpu.parallel.partition import stage_layers
+
+        cfg = gen.cfg
+        l_max = max(stage_layers(cfg.n_layer, pp))
+        params = gen.params
+        blocks = params.get("blocks") if isinstance(params, dict) else None
+        if blocks is None:
+            return params_dev
+        blocks_b = sum(int(leaf.nbytes) for _, leaf in iter_leaves(blocks))
+        head_b = sum(
+            int(leaf.nbytes)
+            for k, v in params.items() if k != "blocks"
+            for _, leaf in iter_leaves(v)
+        )
+        return blocks_b // cfg.n_layer * l_max + head_b
+    except Exception:
+        return params_dev
+
+
+def _tree_leaves(x):
+    import jax
+
+    return jax.tree_util.tree_leaves(x)
+
+
+def flow_preflight(
+    engine,
+    origin: Optional[str] = None,
+    min_bytes: int = DEFAULT_MIN_BYTES,
+    hbm_gb: Optional[float] = None,
+    goldens: Optional[Dict[str, Any]] = None,
+    golden_tolerance: Optional[float] = None,
+) -> FlowReport:
+    """Run the liveness pass over one serving engine — abstract
+    (`trace_serving`) or live (bench / mdi-serve: tracing is side-band,
+    the jit cache and CompileGuard counters are untouched).  Purely
+    host-side: `.trace()` only, never `.lower()`, never a device."""
+    origin = origin or type(engine).__name__
+    specs = engine.enumerate_executables()
+    fraction = _params_device_fraction(engine.gen)
+    findings, profiles = analyze_flow(
+        specs, origin=origin, min_bytes=min_bytes,
+        params_fraction=fraction,
+    )
+    breakdown: Dict[str, Any] = {}
+    if hbm_gb is not None:
+        findings += _check_hbm_budget(
+            engine, profiles, origin, hbm_gb, breakdown
+        )
+    if goldens is not None:
+        findings += _check_goldens(
+            profiles, goldens, origin, golden_tolerance
+        )
+    return FlowReport(
+        origin=origin, findings=findings, profiles=profiles,
+        breakdown=breakdown,
+    )
+
+
+# ---------------------------------------------------------------------------
+# launch gate (bench.py / mdi-serve)
+# ---------------------------------------------------------------------------
+
+
+def flow_refusal_text(tool: str) -> str:
+    return (f"{tool}: mdi-flow preflight refused the launch "
+            "(re-run with --no-preflight to launch anyway)")
+
+
+def enforce_flow_preflight(
+    report: FlowReport, tool: str, allow: bool = False, emit=None
+) -> bool:
+    """Mirror of mdi-ir's `enforce_ir_preflight` for the liveness pass:
+    emit every finding, refuse on errors unless `allow`
+    (--no-preflight)."""
+    if emit is None:
+        def emit(line):
+            print(line, file=sys.stderr)
+    for line in report.render_findings():
+        emit(f"{tool}: flow-preflight: {line}")
+    if not report.errors or allow:
+        return True
+    raise SystemExit(flow_refusal_text(tool))
+
+
+def flow_detail(report: FlowReport) -> Dict[str, Any]:
+    """The compact per-row record bench.py stores under
+    `detail.liveness`."""
+    return {
+        "findings": len(report.errors),
+        "warnings": len(report.warnings),
+        "peak_bytes": {p.name: p.peak_bytes for p in report.profiles},
+        "device_peak_bytes": {
+            p.name: p.device_peak_bytes for p in report.profiles
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mdi-flow",
+        description="Buffer-liveness static analysis: per-executable live "
+        "ranges, donation-aware aliasing and a static peak-HBM high-water "
+        "over the serving compile set — no checkpoint, no device, no "
+        "compile (see docs/analysis.md, 'Buffer liveness (mdi-flow)')",
+    )
+    src = ap.add_argument_group("model source")
+    src.add_argument("--model", default=None, help="registry model name")
+    src.add_argument("--config", default=None, metavar="FILE",
+                     help="model_config.yaml / config.json to trace")
+    par = ap.add_argument_group("parallel plan")
+    par.add_argument("--tp", type=int, default=1,
+                     help="tensor-parallel mesh axis (abstract devices)")
+    par.add_argument("--pp", type=int, default=1,
+                     help="pipeline-parallel serving stages (>=2 routes "
+                     "to PipelinedServingEngine, exactly like a real "
+                     "launch)")
+    run = ap.add_argument_group("run shape")
+    run.add_argument("--seq-len", type=int, default=None,
+                     help="engine window (default: model context)")
+    run.add_argument("--dtype", default="bfloat16",
+                     choices=("bfloat16", "float16", "float32"))
+    run.add_argument("--quantize", default="none",
+                     choices=("none", "int8", "w8a8"))
+    srv = ap.add_argument_group("serving (ServingConfig)")
+    srv.add_argument("--block-size", type=int, default=16)
+    srv.add_argument("--max-batch", type=int, default=8)
+    srv.add_argument("--prefill-chunk", type=int, default=128)
+    srv.add_argument("--token-budget", type=int, default=None)
+    srv.add_argument("--decode-chunk", type=int, default=8)
+    srv.add_argument("--spec-k", type=int, default=0)
+    srv.add_argument("--kv-dtype", default="auto",
+                     help="paged-pool storage dtype (e.g. int8)")
+    seq = ap.add_argument_group("sequential generate() path")
+    seq.add_argument("--sequential", action="store_true",
+                     help="also profile the generate() compile set for "
+                     "the workload below")
+    seq.add_argument("--batch", type=int, default=1)
+    seq.add_argument("--prompt-len", type=int, default=32)
+    seq.add_argument("--new-tokens", type=int, default=32)
+    seq.add_argument("--chunk-size", type=int, default=16)
+    bud = ap.add_argument_group("budgets")
+    bud.add_argument("--hbm-gb", type=float, default=None,
+                     help="per-device HBM budget: the static high-water "
+                     "must fit (hbm-over-budget)")
+    bud.add_argument("--min-bytes", type=int, default=DEFAULT_MIN_BYTES,
+                     help="missed-donation / live-range-bloat floor "
+                     "(bytes)")
+    bud.add_argument("--goldens", default=None, metavar="FILE",
+                     help="committed golden budgets+digests to pin "
+                     "against (peak-memory-regression / jaxpr-drift)")
+    bud.add_argument("--update-goldens", action="store_true",
+                     help="write this run's budgets/digests into "
+                     "--goldens (merging other origins) and exit 0")
+    bud.add_argument("--golden-tolerance", type=float, default=None,
+                     help="peak growth fraction that trips the "
+                     "regression rule (default: the goldens file's, "
+                     f"else {DEFAULT_GOLDEN_TOLERANCE})")
+    ap.add_argument("--suppress", action="append", default=[],
+                    metavar="RULE=WHY",
+                    help="suppress a rule WITH a justification "
+                    "(mandatory); repeatable")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="grandfather findings via an mdi-lint-style "
+                    "baseline")
+    ap.add_argument("--update-baseline", default=None, metavar="FILE",
+                    help="write the current findings as the baseline and "
+                    "exit 0")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print the flow rule registry and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checks:
+        width = max(len(c) for c in FLOW_RULES)
+        for code, (sev, summary) in FLOW_RULES.items():
+            print(f"{code:<{width}}  [{sev}] {summary}")
+        return 0
+    reasons: Dict[str, str] = {}
+    for s in args.suppress:
+        rule, _, why = s.partition("=")
+        rule, why = rule.strip(), why.strip()
+        if rule not in FLOW_RULES:
+            print(f"mdi-flow: unknown rule in --suppress: {rule!r}",
+                  file=sys.stderr)
+            return 2
+        if not why:
+            print("mdi-flow: --suppress requires a justification: "
+                  f"{rule}=<why this is acceptable>", file=sys.stderr)
+            return 2
+        reasons[rule] = why
+    goldens = None
+    if args.goldens and not args.update_goldens:
+        try:
+            goldens = load_goldens(Path(args.goldens))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"mdi-flow: {e}", file=sys.stderr)
+            return 2
+    try:
+        if args.config:
+            cfg = Config.from_file(args.config)
+        elif args.model:
+            cfg = Config.from_name(args.model)
+        else:
+            raise ValueError("need --model or --config")
+        serving = ServingConfig(
+            block_size=args.block_size,
+            max_batch=args.max_batch,
+            prefill_chunk=args.prefill_chunk,
+            token_budget=args.token_budget,
+            decode_chunk=args.decode_chunk,
+            spec_k=args.spec_k,
+            kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
+        )
+        engine = trace_serving(
+            cfg,
+            serving,
+            tp=args.tp,
+            pp=args.pp,
+            dtype=args.dtype,
+            quantize=None if args.quantize == "none" else args.quantize,
+            max_seq_length=args.seq_len,
+        )
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"mdi-flow: {e}", file=sys.stderr)
+        return 2
+    name = args.model or Path(args.config).stem
+    mesh_tag = "".join(
+        t for t in (f"@tp{args.tp}" if args.tp > 1 else "",
+                    f"@pp{args.pp}" if args.pp > 1 else "")
+    )
+    origin = f"{name}{mesh_tag}"
+    report = flow_preflight(
+        engine,
+        origin=origin,
+        min_bytes=args.min_bytes,
+        hbm_gb=args.hbm_gb,
+        goldens=goldens,
+        golden_tolerance=args.golden_tolerance,
+    )
+    seq_profiles: List[ExecProfile] = []
+    if args.sequential:
+        try:
+            seq_specs = engine.gen.enumerate_executables(
+                batch_size=args.batch,
+                prompt_len=args.prompt_len,
+                max_new_tokens=args.new_tokens,
+                chunk_size=args.chunk_size,
+            )
+        except ValueError as e:
+            print(f"mdi-flow: {e}", file=sys.stderr)
+            return 2
+        f2, seq_profiles = analyze_flow(
+            seq_specs,
+            origin=f"{origin}:generate",
+            min_bytes=args.min_bytes,
+            params_fraction=_params_device_fraction(engine.gen),
+        )
+        if goldens is not None:
+            f2 += _check_goldens(
+                seq_profiles, goldens, f"{origin}:generate",
+                args.golden_tolerance,
+            )
+        report.findings += f2
+    if args.update_goldens:
+        gpath = Path(args.goldens) if args.goldens else DEFAULT_GOLDENS
+        write_goldens(gpath, origin, report.profiles)
+        if seq_profiles:
+            write_goldens(gpath, f"{origin}:generate", seq_profiles)
+        n = len(report.profiles) + len(seq_profiles)
+        print(f"mdi-flow: wrote {n} budget(s) for {origin} to {gpath}")
+        return 0
+    report.profiles += seq_profiles
+    report.suppress(reasons)
+    if args.update_baseline:
+        Baseline.from_findings(report.findings).save(
+            Path(args.update_baseline)
+        )
+        print(f"mdi-flow: wrote {len(report.findings)} finding(s) to "
+              f"{args.update_baseline}")
+        return 0
+    errors = report.errors
+    if args.baseline:
+        new, _old = Baseline.load(Path(args.baseline)).split(errors)
+        errors = new
+    if args.format == "json":
+        out = report.as_json()
+        out["new_errors"] = len(errors)
+        print(json.dumps(out, indent=2))
+    else:
+        print(report.render_text())
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -326,6 +326,17 @@ def main(argv=None):
     ir_report = ir_preflight(engine, origin="mdi-serve")
     enforce_ir_preflight(ir_report, "mdi-serve", allow=args.no_preflight)
 
+    # buffer-liveness preflight over the same traced executables: donation
+    # aliasing, live-range bloat, static peak-HBM (docs/analysis.md,
+    # "Buffer liveness (mdi-flow)")
+    from mdi_llm_tpu.analysis.liveness import (
+        enforce_flow_preflight,
+        flow_preflight,
+    )
+
+    flow_report = flow_preflight(engine, origin="mdi-serve")
+    enforce_flow_preflight(flow_report, "mdi-serve", allow=args.no_preflight)
+
     if args.synthetic:
         trace = synthetic_trace(
             args.synthetic, cfg.vocab_size, gen.max_seq_length, args.n_tokens

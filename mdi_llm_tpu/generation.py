@@ -669,6 +669,9 @@ class Generator:
                 )
             return t
 
+        # every generate() dispatch takes params at argnum 0 and the dense
+        # kv cache at argnum 2 (same role map as the serving engine's set)
+        roles = {0: "params", 2: "kv"}
         specs = [
             ExecutableSpec(
                 "prefill",
@@ -677,6 +680,7 @@ class Generator:
                 (params, sds((B, Tb), i32), kv_abs(B), sds((B,), i32)),
                 None,
                 (2,),
+                dict(roles),
             )
         ]
         statics = {"mode": sample_mode(temperature, top_k, top_p), "top_k": top_k}
@@ -714,6 +718,7 @@ class Generator:
                         (params, sds((nb,), i32), kvn, sds((nb,), i32), key, t_op, p_op),
                         dict(statics),
                         (2,),
+                        dict(roles),
                     )
                 )
         if speculative:
@@ -726,6 +731,7 @@ class Generator:
                     (params, sds((1, K + 1), i32), kv_abs(1), sds((1,), i32)),
                     None,
                     (2,),
+                    dict(roles),
                 )
             )
         return specs

@@ -59,7 +59,11 @@ class ExecutableSpec(NamedTuple):
 
     `args` are `ShapeDtypeStruct` pytrees (see `abstractify`);
     `static_kwargs` holds the jit static arguments (None when the fn has
-    none); `donate` mirrors the fn's `donate_argnums`."""
+    none); `donate` mirrors the fn's `donate_argnums`; `roles` names the
+    semantically special positional args (``{argnum: "params" | "kv"}``)
+    so byte-attribution passes (mdi-flow, analysis/liveness.py) can tell
+    the model weights and the paged pool apart from run operands without
+    guessing by size."""
 
     label: str  # dispatch path: mixed / decode / decode_chunk / verify / ...
     key: Tuple  # static-shape key, e.g. (B, T)
@@ -67,6 +71,7 @@ class ExecutableSpec(NamedTuple):
     args: Tuple  # abstract positional args, in dispatch order
     static_kwargs: Optional[Dict[str, Any]]  # jit static args, or None
     donate: Tuple[int, ...]  # donated positional indices (donate_argnums)
+    roles: Optional[Dict[int, str]] = None  # argnum -> "params"/"kv"/...
 
     @property
     def name(self) -> str:

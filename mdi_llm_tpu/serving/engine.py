@@ -762,6 +762,10 @@ class ServingEngine:
         t_op = abstractify(self._t_op)
         p_op = abstractify(self._p_op)
         statics = {"mode": self._sample_mode, "top_k": self.cfg.top_k}
+        # every serving dispatch takes params at argnum 0 and the paged
+        # pool at argnum 2 — named so mdi-flow's byte attribution (and any
+        # other ExecutableSpec consumer) need not guess by size
+        roles = {0: "params", 2: "kv"}
         specs: List[Any] = []
         for label, k in self.reachable_signatures():
             if label == "mixed":
@@ -772,7 +776,8 @@ class ServingEngine:
                     sds((B,), i32), key, t_op, p_op,
                 )
                 specs.append(ExecutableSpec(
-                    "mixed", k, self._mixed_fn(B, T), args, dict(statics), (2,)
+                    "mixed", k, self._mixed_fn(B, T), args, dict(statics),
+                    (2,), dict(roles),
                 ))
             elif label == "decode":
                 args = (
@@ -780,7 +785,8 @@ class ServingEngine:
                     key, t_op, p_op,
                 )
                 specs.append(ExecutableSpec(
-                    "decode", k, self._decode_fn(B), args, dict(statics), (2,)
+                    "decode", k, self._decode_fn(B), args, dict(statics),
+                    (2,), dict(roles),
                 ))
             elif label == "decode_chunk":
                 K = k[1]
@@ -790,13 +796,14 @@ class ServingEngine:
                 )
                 specs.append(ExecutableSpec(
                     "decode_chunk", k, self._decode_chunk_fn(B, K), args,
-                    dict(statics), (2,),
+                    dict(statics), (2,), dict(roles),
                 ))
             elif label == "verify":
                 T = k[1]
                 args = (params, sds((B, T), i32), kv, tables, sds((B,), i32))
                 specs.append(ExecutableSpec(
-                    "verify", k, self._verify_fn(B, T), args, None, (2,)
+                    "verify", k, self._verify_fn(B, T), args, None, (2,),
+                    dict(roles),
                 ))
         return specs
 

@@ -67,6 +67,22 @@ jax compatibility: pp-only meshes run on both shard_map generations
 shard_map crashes XLA's SPMD partitioner on the ring's in-scan KV-pool
 scatters, so tp x pp on such builds is refused at engine construction
 with the upgrade path spelled out.
+
+Static analysis (mdi-ir / mdi-flow)
+-----------------------------------
+The ring engine enumerates the SAME `ExecutableSpec` set as the base
+engine (inherited `enumerate_executables`, including the argnum roles
+params=0 / kv=2), so both analyzers see the pp executables with zero
+pipeline-specific seams.  mdi-flow's liveness model descends into each
+ring body — the `shard_map` interior is already per-shard, so its scan
+carry (the circling payload), the stage's padded block stack and the
+per-stage KV-pool shard are counted ONCE per device, while the
+inherited kv donation (argnum 2, `donate_argnums=(2,)` on every ring
+fn above) aliases the pool in place exactly like the single-device
+engine; the tier-1 self-check pins the pp=2 compile set
+donation-clean.  Per-stage param bytes use
+`parallel/partition.stage_layers` (l_max blocks + replicated
+embeddings/head), mirroring mdi-audit's pipeline budget.
 """
 
 from __future__ import annotations

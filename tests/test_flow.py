@@ -1,0 +1,558 @@
+"""mdi-flow: jaxpr buffer-liveness analysis of the serving compile set.
+
+Four layers under test:
+
+1. the per-rule checkers — every shipped FLOW_RULES entry has a
+   PLANTED-bug fixture it must catch and a clean twin it must pass,
+   enforced by a registry-wide property test (a check that can't fail
+   proves nothing);
+2. the static byte model — interior temp peaks are loop-length
+   invariant (one allocation per body, like XLA's buffer reuse),
+   digests are deterministic, and the CALIBRATION test compiles the
+   REAL mixed and decode_chunk executables on CPU and pins the static
+   high-water within 20% of XLA's own `memory_analysis` (in float32:
+   the CPU backend materializes f32 upcasts of bf16 params — an
+   emulation artifact TPUs don't have);
+3. the repo self-check — the registry model's serving engines are
+   donation-clean at single-device, tp=2 and pp=2, with a trip-wired
+   backend_compile / device_put proving the whole pass never compiles
+   or places a buffer; the committed goldens/flow-goldens.json stays
+   in sync (drift here = re-run --update-goldens deliberately);
+4. the CLI + integrations — exit codes 0/1/2, --format json, the
+   goldens round-trip, the bench/serve gate, the mdi-audit --liveness
+   agreement, and the mdi-check aggregate gate.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.analysis.check import main as check_main
+from mdi_llm_tpu.analysis.ir import trace_serving
+from mdi_llm_tpu.analysis.liveness import (
+    FLOW_RULES,
+    FlowReport,
+    _check_goldens,
+    analyze_flow,
+    enforce_flow_preflight,
+    flow_detail,
+    flow_preflight,
+    interior_peak_bytes,
+    jaxpr_digest,
+    load_goldens,
+    main,
+    profile_executable,
+    write_goldens,
+)
+from mdi_llm_tpu.config import Config, ServingConfig
+from mdi_llm_tpu.obs.device import ExecutableSpec
+
+sds = jax.ShapeDtypeStruct
+f32 = jnp.float32
+
+MODEL = "pythia-14m"  # the registry self-check model
+REPO = Path(__file__).resolve().parent.parent
+
+_ENGINES = {}
+
+
+def _engine(tp=1, pp=1, spec_k=0, dtype="bfloat16"):
+    key = (tp, pp, spec_k, dtype)
+    if key not in _ENGINES:
+        _ENGINES[key] = trace_serving(
+            Config.from_name(MODEL), ServingConfig(spec_k=spec_k),
+            tp=tp, pp=pp, dtype=dtype, max_seq_length=256,
+        )
+    return _ENGINES[key]
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# per-rule planted-bug / clean fixtures (+ the registry property test)
+# ---------------------------------------------------------------------------
+
+BUF = sds((512, 1024), f32)  # 2 MiB — above the 1 MiB default floor
+
+
+def _spec(name, fn, args, donate=()):
+    return ExecutableSpec(name, (), fn, args, None, tuple(donate))
+
+
+def _donation_spec(donated: bool):
+    fn = jax.jit(
+        lambda b: b.at[0].add(1.0),
+        donate_argnums=(0,) if donated else (),
+    )
+    return _spec("upd", fn, (BUF,), donate=(0,) if donated else ())
+
+
+def _bloat_spec(read: bool):
+    def stepper(buf, xs):
+        def body(carry, x):
+            dead, acc = carry
+            acc = acc + x.sum() + (dead[0, 0] if read else 0.0)
+            return (dead, acc), ()
+        (_, acc), _ = jax.lax.scan(body, (buf, jnp.float32(0.0)), xs)
+        return acc
+
+    return _spec("loop", jax.jit(stepper), (BUF, sds((8, 4), f32)))
+
+
+def _boom_spec(ok: bool):
+    def boom(a):
+        raise RuntimeError("boom")
+
+    return _spec("boom", jax.jit((lambda a: a * 2) if ok else boom),
+                 (sds((4,), f32),))
+
+
+def _budget_findings(hbm_gb):
+    return flow_preflight(_engine(), origin="t", hbm_gb=hbm_gb).findings
+
+
+def _golden_findings(tamper):
+    _, profiles = analyze_flow([_donation_spec(True)], origin="t")
+    (p,) = profiles
+    entry = {"peak_bytes": p.peak_bytes, "digest": p.digest,
+             "ops": dict(p.ops)}
+    if tamper == "peak":
+        entry["peak_bytes"] = max(1, p.peak_bytes // 2)
+    elif tamper == "digest":
+        entry["digest"] = "0" * 16
+        entry["ops"] = {"fake_op": 3}
+    goldens = {"tolerance": 0.10, "budgets": {f"t::{p.name}": entry}}
+    return _check_goldens(profiles, goldens, "t")
+
+
+# rule -> zero-arg callable returning findings; the planted twin MUST
+# contain the rule, the clean twin must NOT — and the registry test
+# below pins that every shipped rule has both
+BAD = {
+    "missed-donation": lambda: analyze_flow([_donation_spec(False)])[0],
+    "live-range-bloat": lambda: analyze_flow([_bloat_spec(False)])[0],
+    "trace-failure": lambda: analyze_flow([_boom_spec(False)])[0],
+    "hbm-over-budget": lambda: _budget_findings(0.001),
+    "peak-memory-regression": lambda: _golden_findings("peak"),
+    "jaxpr-drift": lambda: _golden_findings("digest"),
+}
+GOOD = {
+    "missed-donation": lambda: analyze_flow([_donation_spec(True)])[0],
+    "live-range-bloat": lambda: analyze_flow([_bloat_spec(True)])[0],
+    "trace-failure": lambda: analyze_flow([_boom_spec(True)])[0],
+    "hbm-over-budget": lambda: _budget_findings(64.0),
+    "peak-memory-regression": lambda: _golden_findings(None),
+    "jaxpr-drift": lambda: _golden_findings(None),
+}
+
+
+def test_every_shipped_rule_has_a_fixture_pair():
+    """Registry-wide property: adding a FLOW_RULES entry without a
+    planted/clean fixture pair fails here."""
+    assert set(BAD) == set(FLOW_RULES) == set(GOOD)
+
+
+@pytest.mark.parametrize("rule", sorted(FLOW_RULES))
+def test_planted_fixture_caught_and_clean_twin_passes(rule):
+    assert rule in rules_of(BAD[rule]()), f"{rule}: planted bug missed"
+    assert rule not in rules_of(GOOD[rule]()), f"{rule}: clean twin flagged"
+
+
+def test_missed_donation_message_names_the_argnum():
+    findings, _ = analyze_flow([_donation_spec(False)])
+    (f,) = findings
+    assert "argnum 0" in f.message and "donate_argnums" in f.message
+    assert "2.0 MiB" in f.message
+
+
+def test_live_range_bloat_names_the_extending_site():
+    findings, _ = analyze_flow([_bloat_spec(False)])
+    (f,) = findings
+    assert "`scan`" in f.message and "never reads it" in f.message
+    assert f.line_text.startswith("bloat:scan:")
+
+
+def test_min_bytes_floor_silences_small_buffers():
+    findings, _ = analyze_flow([_bloat_spec(False)], min_bytes=1 << 30)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the static byte model
+# ---------------------------------------------------------------------------
+
+
+def test_interior_peak_is_loop_length_invariant():
+    """A scan body's temps are counted ONCE (XLA reuses body buffers
+    across iterations): 4 vs 64 iterations over the same row must give
+    the same interior peak."""
+
+    def make(n):
+        def f(xs):
+            def body(c, x):
+                t = x * 2.0 + 1.0
+                return c + t.sum(), ()
+            out, _ = jax.lax.scan(body, jnp.float32(0.0), xs)
+            return out
+        return jax.jit(f).trace(sds((n, 4096), f32)).jaxpr.jaxpr
+
+    p4, p64 = interior_peak_bytes(make(4)), interior_peak_bytes(make(64))
+    assert p4 == p64 > 0
+
+
+def test_profile_accounting_and_donation_alias():
+    p = profile_executable(_donation_spec(True))
+    nb = 512 * 1024 * 4
+    assert p.argument_bytes == nb and p.output_bytes == nb
+    assert p.alias_bytes == nb  # donated in-place update aliases fully
+    assert p.peak_bytes == nb + p.temp_peak_bytes
+    q = profile_executable(_donation_spec(False))
+    assert q.alias_bytes == 0 and q.peak_bytes >= 2 * nb
+
+
+def test_digest_deterministic_and_discriminating():
+    d1, ops1 = jaxpr_digest(jax.jit(lambda a: a + 1).trace(BUF).jaxpr)
+    d2, _ = jaxpr_digest(jax.jit(lambda a: a + 1).trace(BUF).jaxpr)
+    d3, _ = jaxpr_digest(jax.jit(lambda a: a * 2).trace(BUF).jaxpr)
+    assert d1 == d2 and d1 != d3
+    assert len(d1) == 16 and sum(ops1.values()) >= 1
+
+
+def test_goldens_write_merges_origins_and_load_validates(tmp_path):
+    _, profiles = analyze_flow([_donation_spec(True)], origin="a")
+    g = tmp_path / "g.json"
+    write_goldens(g, "a", profiles)
+    write_goldens(g, "b", profiles)
+    data = load_goldens(g)
+    assert {"a::upd()", "b::upd()"} <= set(data["budgets"])
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="budgets"):
+        load_goldens(bad)
+
+
+def test_calibration_static_peak_within_20pct_of_xla_memory_analysis():
+    """The acceptance gate: compile the REAL mixed and decode_chunk
+    executables on CPU (float32 — see module docstring) and pin the
+    static model against XLA's own accounting
+    (args + outputs + temps − aliases)."""
+    from mdi_llm_tpu.obs.device import abstractify
+
+    engine = _engine(dtype="float32")
+    specs = engine.enumerate_executables()
+    assert {s.label for s in specs} == {"mixed", "decode_chunk"}
+    _, profiles = analyze_flow(specs, origin="calib")
+    prof = {p.name: p for p in profiles}
+    for spec in specs:
+        absargs = tuple(abstractify(a) for a in spec.args)
+        ma = (spec.fn.lower(*absargs, **(spec.static_kwargs or {}))
+              .compile().memory_analysis())
+        xla = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+               + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+        ratio = prof[spec.name].peak_bytes / xla
+        assert 0.8 <= ratio <= 1.2, (
+            f"{spec.name}: static {prof[spec.name].peak_bytes} vs "
+            f"XLA {xla} (ratio {ratio:.3f}) — outside the 20% band"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the repo self-check: registry model, three meshes, zero device use
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tp,pp", [(1, 1), (2, 1), (1, 2)])
+def test_self_check_donation_clean_and_never_touches_a_backend(
+    tp, pp, monkeypatch, devices
+):
+    """The acceptance gate: the full liveness pass on the registry
+    model's live engine shapes is CLEAN (donation sets verified — every
+    executable aliases its kv pool) at single-device, tp=2 and pp=2,
+    and a trip-wired backend_compile / device_put proves the analyzer
+    performs zero backend compiles and zero device transfers."""
+    from jax._src import compiler as jax_compiler
+
+    def tripped(*a, **k):
+        raise AssertionError("mdi-flow touched a backend/device")
+
+    monkeypatch.setattr(jax_compiler, "backend_compile", tripped)
+    monkeypatch.setattr(jax, "device_put", tripped)
+
+    engine = trace_serving(
+        Config.from_name(MODEL), ServingConfig(spec_k=3), tp=tp, pp=pp,
+        max_seq_length=256,
+    )
+    specs = engine.enumerate_executables()
+    assert all(s.roles == {0: "params", 2: "kv"} for s in specs)
+    report = flow_preflight(engine, origin=f"self@tp{tp}pp{pp}",
+                            hbm_gb=64.0)
+    assert report.findings == [], report.render_text()
+    assert len(report.profiles) == 3  # mixed, decode_chunk, verify
+    # the donation sets are live: every executable aliases its kv pool
+    assert all(p.alias_bytes > 0 for p in report.profiles)
+    assert all(p.peak_bytes > 0 and p.device_peak_bytes > 0
+               for p in report.profiles)
+    dev = report.breakdown["per_device"]
+    assert 0 < dev["high_water_bytes"] <= 64 * 2**30
+    if tp > 1:
+        # tp shards params+pool: per-device strictly below global
+        assert all(p.device_peak_bytes < p.peak_bytes
+                   for p in report.profiles)
+
+
+def test_committed_goldens_match_the_current_compile_set():
+    """goldens/flow-goldens.json stays in sync with the registry
+    model's serving IR — drift means review the churn, then re-run
+    `mdi-flow --model pythia-14m --update-goldens` deliberately."""
+    goldens = load_goldens(REPO / "goldens" / "flow-goldens.json")
+    engine = trace_serving(Config.from_name(MODEL), ServingConfig())
+    _, profiles = analyze_flow(engine.enumerate_executables(),
+                               origin=MODEL)
+    findings = _check_goldens(profiles, goldens, MODEL)
+    assert findings == [], "\n".join(f.message for f in findings)
+    # and the committed file actually covers this compile set
+    assert {f"{MODEL}::{p.name}" for p in profiles} <= set(
+        goldens["budgets"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# preflight gate + detail record (bench.py / mdi-serve wiring)
+# ---------------------------------------------------------------------------
+
+
+def test_enforce_flow_preflight_refuses_on_errors_allows_with_flag():
+    report = flow_preflight(_engine(), origin="gate")
+    emitted = []
+    assert enforce_flow_preflight(report, "bench", emit=emitted.append)
+    assert emitted == []  # clean pass stays silent
+
+    findings, profiles = analyze_flow([_boom_spec(False)], origin="gate")
+    broken = FlowReport(origin="gate", findings=findings,
+                        profiles=profiles)
+    with pytest.raises(SystemExit, match="no-preflight"):
+        enforce_flow_preflight(broken, "bench", emit=emitted.append)
+    assert any("trace-failure" in line for line in emitted)
+    assert enforce_flow_preflight(broken, "bench", allow=True,
+                                  emit=emitted.append)
+
+    d = flow_detail(report)
+    assert d["findings"] == 0 and d["warnings"] == 0
+    assert set(d["peak_bytes"]) == set(d["device_peak_bytes"]) != set()
+
+
+def test_audit_liveness_path_agrees_with_flow_temp_peak():
+    """mdi-audit --liveness replaces the analytic activation term with
+    mdi-flow's worst interior temp peak; the two paths must agree
+    exactly on the registry model (same engine tuple), and plans that
+    are not engine-enumerable keep the heuristic."""
+    from mdi_llm_tpu.analysis.audit import preflight
+
+    cfg = Config.from_name(MODEL)
+    report = preflight(cfg, serving=ServingConfig(), seq_len=256,
+                       origin="t", liveness=True)
+    dev = report.breakdown["per_device"]
+    assert dev["act_source"] == "liveness"
+    _, profiles = analyze_flow(_engine().enumerate_executables())
+    assert dev["act_bytes"] == max(p.temp_peak_bytes for p in profiles)
+
+    heur = preflight(cfg, serving=ServingConfig(), seq_len=256,
+                     origin="t")
+    assert heur.breakdown["per_device"]["act_source"] == "heuristic"
+    # no-serving plans fall back even with the flag on
+    dense = preflight(cfg, seq_len=256, origin="t", liveness=True)
+    assert dense.breakdown["per_device"]["act_source"] == "heuristic"
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, json, goldens round-trip, suppression, help
+# ---------------------------------------------------------------------------
+
+
+def test_cli_clean_self_check_exit_0(capsys):
+    rc = main(["--model", MODEL, "--seq-len", "256"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "findings: none" in out and "mixed(8,136)" in out
+    assert "digest=" in out
+
+
+def test_cli_goldens_round_trip_regression_and_drift(tmp_path, capsys):
+    g = tmp_path / "g.json"
+    base = ["--model", MODEL, "--seq-len", "256"]
+    assert main(base + ["--goldens", str(g), "--update-goldens"]) == 0
+    assert main(base + ["--goldens", str(g)]) == 0
+    capsys.readouterr()
+
+    data = json.loads(g.read_text())
+    for entry in data["budgets"].values():
+        entry["peak_bytes"] = max(1, entry["peak_bytes"] // 2)
+    g.write_text(json.dumps(data))
+    assert main(base + ["--goldens", str(g)]) == 1
+    assert "peak-memory-regression" in capsys.readouterr().out
+
+    for entry in data["budgets"].values():
+        entry["peak_bytes"] = entry["peak_bytes"] * 2
+        entry["digest"] = "0" * 16
+        entry["ops"] = {}
+    g.write_text(json.dumps(data))
+    rc = main(base + ["--goldens", str(g)])
+    out = capsys.readouterr().out
+    assert rc == 0  # drift is a warning, not a gate
+    assert "jaxpr-drift" in out and "op-level diff" in out
+
+
+def test_cli_hbm_budget_json_exit_1(capsys):
+    rc = main(["--model", MODEL, "--seq-len", "256", "--hbm-gb",
+               "0.001", "--format", "json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["errors"] >= 1 and out["new_errors"] >= 1
+    assert any(f["rule"] == "hbm-over-budget" for f in out["findings"])
+    assert out["breakdown"]["per_device"]["high_water_bytes"] > 0
+    assert all("peak_bytes" in e for e in out["executables"])
+
+
+def test_cli_suppress_needs_known_rule_and_justification(capsys):
+    assert main(["--model", MODEL, "--suppress", "not-a-rule=x"]) == 2
+    assert main(["--model", MODEL, "--suppress", "hbm-over-budget="]) == 2
+    capsys.readouterr()
+    rc = main(["--model", MODEL, "--seq-len", "256", "--hbm-gb", "0.001",
+               "--suppress", "hbm-over-budget=lab box, budget tracked"])
+    assert rc == 0
+    assert "suppressed: hbm-over-budget (lab box" in capsys.readouterr().out
+
+
+def test_cli_usage_errors_exit_2(capsys):
+    assert main([]) == 2  # no --model/--config
+    assert main(["--model", "no-such-model-xyz"]) == 2
+    assert main(["--model", MODEL, "--goldens", "/no/such/file.json"]) == 2
+    err = capsys.readouterr().err
+    assert "mdi-flow:" in err
+
+
+def test_cli_list_checks_covers_registry(capsys):
+    assert main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    for rule in FLOW_RULES:
+        assert rule in out
+
+
+def test_cli_help_covers_flags(capsys):
+    with pytest.raises(SystemExit):
+        main(["--help"])
+    text = capsys.readouterr().out
+    for flag in ("--model", "--config", "--tp", "--pp", "--seq-len",
+                 "--dtype", "--quantize", "--block-size", "--max-batch",
+                 "--prefill-chunk", "--token-budget", "--decode-chunk",
+                 "--spec-k", "--kv-dtype", "--sequential", "--hbm-gb",
+                 "--min-bytes", "--goldens", "--update-goldens",
+                 "--golden-tolerance", "--suppress", "--baseline",
+                 "--update-baseline", "--format", "--list-checks"):
+        assert flag in text, f"{flag} missing from mdi-flow --help"
+
+
+# ---------------------------------------------------------------------------
+# mdi-check: the aggregate gate
+# ---------------------------------------------------------------------------
+
+
+def test_check_self_check_all_families_clean(monkeypatch, capsys):
+    """The tier-1 aggregate self-check: lint + audit + ir + flow over
+    the registry model, one engine trace shared by ir/flow, exit 0."""
+    monkeypatch.chdir(REPO)  # default goldens + lint baseline resolve
+    rc = check_main(["--model", MODEL, "--seq-len", "256"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for family in ("lint", "audit", "ir", "flow"):
+        assert f"{family:<6} clean" in out
+    assert "check: PASS" in out
+
+
+def test_check_json_report_and_skip(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    rc = check_main(["--model", MODEL, "--seq-len", "256", "--skip",
+                     "lint", "--skip", "audit", "--format", "json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out["families"]) == {"ir", "flow"}
+    assert out["errors"] == 0
+    assert out["families"]["flow"]["peak_bytes"]
+
+
+def test_check_usage_error_exit_2(capsys):
+    assert check_main([]) == 2  # families need --model/--config
+    assert "mdi-check:" in capsys.readouterr().err
+
+
+def test_check_list_checks_spans_all_families(capsys):
+    assert check_main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    for family in ("lint:", "audit:", "ir:", "flow:"):
+        assert family in out
+    for rule in FLOW_RULES:
+        assert f"flow:{rule}" in out
+
+
+def test_check_help_covers_flags(capsys):
+    with pytest.raises(SystemExit):
+        check_main(["--help"])
+    text = capsys.readouterr().out
+    for flag in ("--model", "--config", "--tp", "--pp", "--hbm-gb",
+                 "--goldens", "--skip", "--paths", "--lint-baseline",
+                 "--format", "--list-checks"):
+        assert flag in text, f"{flag} missing from mdi-check --help"
+
+
+# ---------------------------------------------------------------------------
+# mdi-ir satellite: --const-bytes counts bytes per device
+# ---------------------------------------------------------------------------
+
+
+def test_ir_const_bytes_flag_and_alias():
+    from mdi_llm_tpu.analysis.ir import build_parser
+
+    ap = build_parser()
+    assert ap.parse_args(
+        ["--model", MODEL, "--const-bytes", "123"]
+    ).max_const_bytes == 123
+    assert ap.parse_args(
+        ["--model", MODEL, "--max-const-bytes", "456"]
+    ).max_const_bytes == 456
+
+
+def test_ir_const_bloat_counts_per_device_bytes(devices):
+    """A baked constant sharded over tp=2 counts HALF per device: at a
+    threshold between half and full size the per-device count passes
+    where the global count would have flagged."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mdi_llm_tpu.analysis.ir import analyze_executables, sharding_denom
+    from mdi_llm_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    leaf = sds((4, 8), f32, sharding=NamedSharding(mesh, P(None, "tp")))
+    assert sharding_denom(leaf) == 2
+    assert sharding_denom(sds((4, 8), f32)) == 1
+
+    big = jax.device_put(
+        np.arange(4096, dtype=np.float32).reshape(4, 1024),
+        NamedSharding(mesh, P(None, "tp")),
+    )  # 16 KiB global, 8 KiB per device
+    spec = ExecutableSpec(
+        "bloat", (), jax.jit(lambda a: a + big), (sds((4, 1024), f32),),
+        None, (),
+    )
+    findings, _ = analyze_executables([spec], origin="t",
+                                      max_const_bytes=12 * 1024)
+    assert findings == []  # 8 KiB/device under the 12 KiB threshold
+    findings, _ = analyze_executables([spec], origin="t",
+                                      max_const_bytes=4 * 1024)
+    assert rules_of(findings) == ["baked-constant-bloat"]
+    assert "per device" in findings[0].message
