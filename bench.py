@@ -213,6 +213,17 @@ def build_parser():
                     "so fp and int8 rows at the same budget compare "
                     "resident capacity at EQUAL pool bytes (default: "
                     "full coverage, no cap)")
+    ap.add_argument("--serve-host-pool-mib", type=int, default=0,
+                    help="serve mode: host-RAM KV block tier in MiB (0 = "
+                    "off).  When set, the timed engine swaps preemption "
+                    "victims' blocks to pinned host slabs and spills cold "
+                    "prefix chains there — and the row ALSO runs "
+                    "recompute-only and swap-only twins on the same trace "
+                    "before the warm mark, recording the three-way "
+                    "head-to-head in detail.tier")
+    ap.add_argument("--host-link-gbps", type=float, default=None,
+                    help="serve mode: host<->device bandwidth (GB/s) for "
+                    "the swap cost model (default: per-device-kind table)")
     ap.add_argument("--tp", type=int, default=1,
                     help="serve mode: tensor-parallel devices — the model "
                     "shards under the Megatron rules and the paged KV "
@@ -255,7 +266,7 @@ def build_parser():
 # ---------------------------------------------------------------------------
 
 
-def _serve_config(args, cfg, kv_dtype=...):
+def _serve_config(args, cfg, kv_dtype=..., tier="on"):
     """THE ServingConfig a serve row runs — preflight, warmup engine and
     timed engine all read this one builder so they can never disagree.
 
@@ -264,11 +275,17 @@ def _serve_config(args, cfg, kv_dtype=...):
     itemized `ServingConfig.block_bytes` (payload + int8 scale arrays), so
     an fp and an int8 row at the same budget hold the same pool BYTES and
     differ only in how many blocks those bytes buy (pass `kv_dtype=None`
-    to build the fp twin of an int8 row at the same budget)."""
+    to build the fp twin of an int8 row at the same budget).
+
+    `tier` builds the host-tier variants of one row: "on" (the flagged
+    tier, prefix spill included), "swap" (same slabs, spill off) and
+    "off" (recompute-only — host_pool_mib forced 0) — the three-way
+    head-to-head the serving-cb-tiered suite row records."""
     from mdi_llm_tpu.config import ServingConfig
 
     if kv_dtype is ...:
         kv_dtype = "int8" if args.kv_dtype == "int8" else None
+    host_mib = getattr(args, "serve_host_pool_mib", 0)
     sv = ServingConfig(
         block_size=args.serve_block_size,
         max_batch=args.batch,
@@ -278,6 +295,9 @@ def _serve_config(args, cfg, kv_dtype=...):
         double_buffer=not args.no_double_buffer,
         token_budget=args.serve_token_budget,
         kv_dtype=kv_dtype,
+        host_pool_mib=0 if tier == "off" else host_mib,
+        host_link_gbps=getattr(args, "host_link_gbps", None),
+        host_prefix_spill=tier == "on",
     )
     if args.serve_pool_mib is not None:
         per_block = sv.block_bytes(cfg, args.dtype)["total_bytes"]
@@ -745,6 +765,42 @@ def run_serve(args):
             },
         })
 
+    # tiered rung: run the SAME preempt-heavy trace two more ways before
+    # the warm mark — recompute-only (no tier) and swap-only (no prefix
+    # spill) — so detail.tier carries the head-to-head and the greedy
+    # token-match of swapped resumes against recomputed ones.  All three
+    # variants share the Generator's jit cache (same dispatch shapes; the
+    # tiered warmup above already compiled fetch/restore), so the timed
+    # region below still reports zero post-warmup recompiles
+    tier_head_to_head, tier_recompute_results = None, None
+    tiered = getattr(args, "serve_host_pool_mib", 0) > 0
+    if tiered:
+        tier_head_to_head = {}
+        for mode, tier in (("recompute", "off"), ("swap", "swap")):
+            sv_t = _serve_config(args, cfg, tier=tier)
+            t_warm = build_engine(obs=None, serving=sv_t)
+            for rid, prompt, new in trace:
+                t_warm.add_request(
+                    rid, prompt, min(new, max(2, 2 * args.serve_chunk))
+                )
+            t_warm.run()
+            t_engine = build_engine(obs=None, serving=sv_t)
+            for rid, prompt, new in trace:
+                t_engine.add_request(rid, prompt, new)
+            t0 = time.perf_counter()
+            t_results, t_stats = t_engine.run()
+            t_wall = time.perf_counter() - t0
+            tier_head_to_head[mode] = {
+                "tokens_per_s": round(
+                    t_stats.tokens_generated / t_wall, 2
+                ) if t_wall else 0.0,
+                "preemptions": t_stats.preemptions,
+                "swaps_out": t_stats.swaps_out,
+                "swaps_in": t_stats.swaps_in,
+            }
+            if mode == "recompute":
+                tier_recompute_results = t_results
+
     _mark_warm()
 
     # observe the TIMED engine only: per-request TTFT/TPOT/E2E/queue-wait
@@ -873,6 +929,50 @@ def run_serve(args):
         detail["pipeline"] = engine.pipeline_fill()
     if fp_ref is not None:
         detail["fp_reference"] = fp_ref
+    if tiered and engine.host_tier is not None:
+        # restore-hidden fraction: the host-side restore ISSUE time vs the
+        # link-model estimate of the full transfer — the remainder rode
+        # behind the next decode chunk's device work
+        link = engine.host_tier.cost_model.link_gbps
+        est_s = stats.swap_in_bytes / (link * 1e9) if link > 0 else 0.0
+        hidden = (
+            round(max(0.0, min(1.0, 1.0 - stats.restore_issue_s / est_s)), 4)
+            if est_s > 0 else None
+        )
+        match_tok = total_tok = 0
+        if tier_recompute_results is not None:
+            # greedy token-identity of swapped resumes vs recompute — the
+            # tier's correctness contract, banked in the row itself
+            for rid, prompt, _new in trace:
+                a = tier_recompute_results.get(rid, [])[len(prompt):]
+                b = results.get(rid, [])[len(prompt):]
+                n = 0
+                while n < min(len(a), len(b)) and a[n] == b[n]:
+                    n += 1
+                match_tok += n
+                total_tok += max(len(a), 1)
+        tier_head_to_head["swap_spill"] = {
+            "tokens_per_s": round(total, 2),
+            "preemptions": stats.preemptions,
+            "swaps_out": stats.swaps_out,
+            "swaps_in": stats.swaps_in,
+        }
+        detail["tier"] = {
+            "host_pool_mib": args.serve_host_pool_mib,
+            "host_blocks": engine.host_tier.store.num_slots,
+            "host_link_gbps": link,
+            "swap_out_bytes": stats.swap_out_bytes,
+            "swap_in_bytes": stats.swap_in_bytes,
+            "swaps_out": stats.swaps_out,
+            "swaps_in": stats.swaps_in,
+            "prefix_hits_host": stats.prefix_hits_host,
+            "restore_issue_s": round(stats.restore_issue_s, 4),
+            "restore_hidden_fraction": hidden,
+            "swap_token_match_rate": (
+                round(match_tok / total_tok, 4) if total_tok else None
+            ),
+            "head_to_head": tier_head_to_head,
+        }
     return {
         "metric": f"serving tokens/sec/chip ({args.model}, cb, "
                   f"slots={args.batch}, reqs={n_requests}{tp_tag})",
@@ -1500,6 +1600,24 @@ SUITE_ROWS = [
                    "--serve-pool-mib", "24"],
         "ladder": [["--serve-pool-mib", "48"], ["--kv-dtype", "auto"]],
         "timeout": 900,
+    },
+    {  # the TIERED-KV rung: the cb trace over a pool capped small enough
+        # to thrash (sustained preemption) with a host-RAM block tier
+        # under it — preemption victims swap their blocks to pinned host
+        # slabs and resume without re-prefill, cold prefix chains spill
+        # there instead of dropping.  The row runs the SAME trace three
+        # ways (recompute-only / swap / swap+prefix-spill) and banks the
+        # head-to-head tokens/s, swap bytes, the restore-hidden fraction
+        # and the swap-vs-recompute greedy token-match rate in
+        # detail.tier.  The ladder relaxes the thrash cap, then drops the
+        # tier so a host-tier failure still records a serving row
+        "name": "serving-cb-tiered",
+        "flags": ["--mode", "serve", "--batch", "8", "--seq-len", "512",
+                   "--new-tokens", "128", "--serve-pool-mib", "48",
+                   "--serve-host-pool-mib", "256"],
+        "ladder": [["--serve-pool-mib", "96"],
+                   ["--serve-host-pool-mib", "0"]],
+        "timeout": 1200,
     },
     {  # the OPEN-SYSTEM serving row (ROADMAP item 1's headline): Poisson
         # arrivals through the async front-end sweep offered load for the

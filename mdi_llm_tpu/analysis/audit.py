@@ -111,6 +111,12 @@ AUDIT_RULES: Dict[str, Tuple[str, str]] = {
         "queue bound rejects everything, or it keeps every slot occupied "
         "over a pool too small to hold all slots' reservation headroom "
         "(sustained preemption thrash)"),
+    "bad-host-tier": (
+        ERROR, "the host KV block tier cannot work as configured: "
+        "host_pool_mib exceeds the --host-gb budget, prefix spill is on "
+        "without prefix_caching (no hash chains to key spilled blocks), "
+        "or the swap cost model sees a zero-bandwidth host link (it "
+        "would never choose to swap)"),
     "bad-kernel-tuning": (
         ERROR, "a ragged-kernel tuning-table entry cannot run on this "
         "config/device: kv_step does not divide block_size, q_pack does "
@@ -906,6 +912,44 @@ def _check_kernel_tuning(plan: PlanSpec, findings, breakdown, bb) -> None:
         ))
 
 
+def _check_host_tier(
+    plan: PlanSpec, sv: ServingConfig, findings: List[Finding], breakdown
+) -> None:
+    """The HBM->host block tier's static preconditions
+    (serving/host_tier.py): a spill keyed on nothing, a cost model that
+    can never choose to swap, or a slab allocation the host budget
+    cannot hold are all launch-time mistakes, not runtime surprises."""
+    if sv.host_pool_mib <= 0:
+        return
+    host_bytes = breakdown["kv_pool"]["host_pool_bytes"]
+    if plan.host_gb is not None:
+        budget = int(float(plan.host_gb) * GiB)
+        if host_bytes > budget:
+            findings.append(_finding(
+                plan, "bad-host-tier",
+                f"host_pool_mib={sv.host_pool_mib} allocates "
+                f"{host_bytes / GiB:.2f} GiB of pinned block slabs, over "
+                f"the {float(plan.host_gb):g} GiB --host-gb budget — "
+                "shrink the tier or raise the budget",
+            ))
+    if sv.host_prefix_spill and not sv.prefix_caching:
+        findings.append(_finding(
+            plan, "bad-host-tier",
+            "host_prefix_spill=True with prefix_caching=False: spilled "
+            "blocks are keyed by the prefix hash chain, which only exists "
+            "under prefix caching — enable prefix_caching or set "
+            "host_prefix_spill=False (swap-only tier)",
+        ))
+    if sv.resolved_host_link_gbps() <= 0:
+        findings.append(_finding(
+            plan, "bad-host-tier",
+            f"host_link_gbps={sv.host_link_gbps:g}: the swap cost model "
+            "prices every transfer at infinite seconds, so preemption "
+            "always recomputes and the tier never swaps — set a real "
+            "bandwidth (or leave it None for the device-table default)",
+        ))
+
+
 def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
     sv = plan.serving
     if sv is None:
@@ -1041,7 +1085,13 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             # open-system bound (None for replay configs): the
             # bad-server-config checker sized it against the headroom
             "admission_queue": sv.admission_queue,
+            # host KV tier (serving/host_tier.py): whole-block slab bytes,
+            # byte-exact vs the live HostBlockStore (the MiB budget rounds
+            # down to full tp=1 blocks); 0/0 when the tier is off
+            "host_pool_bytes": sv.host_pool_bytes(plan.cfg, plan.kv_dtype),
+            "host_blocks": sv.num_host_blocks(plan.cfg, plan.kv_dtype),
         }
+        _check_host_tier(plan, sv, findings, breakdown)
         _check_kernel_tuning(plan, findings, breakdown, bb)
         pp = _serving_pp(plan)
         if pp > 1 and plan.cfg.n_layer >= pp:
@@ -1120,6 +1170,7 @@ def preflight(
     quantize: Optional[str] = None,
     serving: Optional[ServingConfig] = None,
     hbm_gb: Optional[float] = None,
+    host_gb: Optional[float] = None,
     origin: str = "<preflight>",
     liveness: bool = False,
 ) -> AuditReport:
@@ -1154,6 +1205,7 @@ def preflight(
         quantize=None if quantize in (None, "none") else quantize,
         serving=serving,
         hbm_gb=hbm_gb,
+        host_gb=host_gb,
         # the pipeline ring replicates embeddings/head on every stage
         shard_head=not (pipeline if pipeline is not None else S > 1),
         origin=origin,
@@ -1258,8 +1310,19 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--token-budget", type=int, default=None,
                      help="unified-step token budget (default: max_batch + "
                      "prefill_chunk)")
+    srv.add_argument("--host-pool-mib", type=int, default=0,
+                     help="host-RAM KV block tier size in MiB (0 = off): "
+                     "preempted sequences swap their blocks to pinned host "
+                     "slabs instead of recomputing, and cold prefix chains "
+                     "spill there (bad-host-tier audits the config)")
+    srv.add_argument("--host-link-gbps", type=float, default=None,
+                     help="host<->device link bandwidth in GB/s for the "
+                     "swap cost model (default: per-device-kind table)")
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget (e.g. 16 for v5e)")
+    ap.add_argument("--host-gb", type=float, default=None,
+                    help="host-RAM budget for the KV block tier "
+                    "(bad-host-tier when --host-pool-mib exceeds it)")
     ap.add_argument("--liveness", action="store_true",
                     help="derive the activation high-water from mdi-flow's "
                     "buffer-liveness pass over the serving compile set "
@@ -1335,6 +1398,8 @@ def _plan_from_args(args) -> PlanSpec:
             # pool: payload + scale bytes both audited); unknown names
             # surface as bad-serving-config, exactly like the engine
             kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
+            host_pool_mib=args.host_pool_mib,
+            host_link_gbps=args.host_link_gbps,
         )
     return PlanSpec(
         cfg=cfg,
@@ -1351,6 +1416,7 @@ def _plan_from_args(args) -> PlanSpec:
         quantize=None if args.quantize == "none" else args.quantize,
         serving=serving,
         hbm_gb=args.hbm_gb,
+        host_gb=args.host_gb,
         shard_head=stages <= 1,
         origin=origin,
     )

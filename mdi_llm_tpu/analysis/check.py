@@ -73,6 +73,13 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--decode-chunk", type=int, default=8)
     srv.add_argument("--spec-k", type=int, default=0)
     srv.add_argument("--kv-dtype", default="auto")
+    srv.add_argument("--host-pool-mib", type=int, default=0,
+                     help="host-RAM KV block tier size in MiB (0 = off); "
+                     "audited by bad-host-tier and credited against the "
+                     "flow hbm-over-budget static peak")
+    srv.add_argument("--host-link-gbps", type=float, default=None,
+                     help="host<->device bandwidth (GB/s) for the swap "
+                     "cost model (default: per-device-kind table)")
     ap.add_argument("--paths", nargs="*", default=None, metavar="PATH",
                     help="files/dirs for the lint family (default: the "
                     "mdi_llm_tpu package next to this file)")
@@ -82,6 +89,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget for the audit and flow "
                     "families")
+    ap.add_argument("--host-gb", type=float, default=None,
+                    help="host-RAM budget for the KV block tier "
+                    "(audit family, bad-host-tier)")
     ap.add_argument("--goldens", default=None, metavar="FILE",
                     help="flow golden budgets (default: "
                     "goldens/flow-goldens.json when present)")
@@ -137,6 +147,8 @@ def run_check(args) -> Dict[str, Any]:
             decode_chunk=args.decode_chunk,
             spec_k=args.spec_k,
             kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
+            host_pool_mib=args.host_pool_mib,
+            host_link_gbps=args.host_link_gbps,
         )
     name = args.model or (Path(args.config).stem if args.config else "?")
     mesh_tag = "".join(
@@ -187,6 +199,7 @@ def run_check(args) -> Dict[str, Any]:
             quantize=None if args.quantize == "none" else args.quantize,
             serving=serving,
             hbm_gb=args.hbm_gb,
+            host_gb=args.host_gb,
             origin=f"check:{origin}",
         )
         report["families"]["audit"] = {

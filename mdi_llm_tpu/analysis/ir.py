@@ -424,6 +424,12 @@ def reachable_serving_set(
         sigs.add(("decode_chunk", (int(max_batch), int(serving.decode_chunk))))
     else:
         sigs.add(("decode", (int(max_batch),)))
+    if serving.host_pool_mib > 0:
+        # host KV tier: swap-out gathers and restore scatters run in one
+        # fixed transfer quantum so the tier adds exactly two executables
+        W = max(1, int(serving.swap_chunk_blocks))
+        sigs.add(("fetch", (W,)))
+        sigs.add(("restore", (W,)))
     return sigs
 
 

@@ -937,10 +937,32 @@ def _check_hbm_budget(
         )
         if worst is None or rest > worst_rest:
             worst, worst_rest = p, rest
-    high_water = params_dev + pool_dev + worst_rest
+    host_credit = 0
+    if serving.host_pool_mib > 0:
+        # host KV tier: swapped-out victims and spilled prefix chains park
+        # in host RAM and their HBM blocks return to the free list, so the
+        # steady-state resident peak drops by the swappable share — capped
+        # by host capacity and by the pool itself (the reserved trash
+        # block 0 never leaves HBM)
+        try:
+            kv_name = serving.resolved_kv_dtype(
+                str(np.dtype(gen.cache_dtype))
+            )
+            max_seq = int(min(
+                gen.max_seq_length or cfg.block_size, cfg.block_size
+            ))
+            n_blocks = serving.num_pool_blocks(max_seq)
+            per_block_dev = pool_dev // max(1, n_blocks)
+            host_credit = min(
+                serving.num_host_blocks(cfg, kv_name), max(0, n_blocks - 1)
+            ) * per_block_dev
+        except (AttributeError, TypeError, ValueError):
+            host_credit = 0
+    high_water = params_dev + pool_dev - host_credit + worst_rest
     breakdown["per_device"] = {
         "params_bytes": int(params_dev),
         "pool_bytes": int(pool_dev),
+        "host_credit_bytes": int(host_credit),
         "high_water_bytes": int(high_water),
         "worst_executable": worst.name if worst else None,
     }
@@ -953,11 +975,13 @@ def _check_hbm_budget(
         message=(
             f"per-device static high-water {high_water / GiB:.2f} GiB "
             f"exceeds the {float(hbm_gb):g} GiB budget (params "
-            f"{params_dev / GiB:.2f} + pool {pool_dev / GiB:.2f} + "
-            f"{worst_rest / GiB:.2f} live at "
+            f"{params_dev / GiB:.2f} + pool {pool_dev / GiB:.2f}"
+            + (f" - host tier {host_credit / GiB:.2f}" if host_credit else "")
+            + f" + {worst_rest / GiB:.2f} live at "
             f"{worst.name if worst else '?'}): shrink the pool "
-            "(max_blocks / kv_dtype=int8), the batch, or the window — "
-            "or raise --hbm-gb if the budget was wrong"
+            "(max_blocks / kv_dtype=int8), the batch, or the window, "
+            "offload with --host-pool-mib — or raise --hbm-gb if the "
+            "budget was wrong"
         ),
         line_text="hbm-over-budget",
     )]

@@ -147,6 +147,7 @@ class PlanSpec:
     ring_perm: Optional[Tuple[Tuple[int, int], ...]] = None
     rank_programs: Optional[List[List[Tuple]]] = None  # per-rank op traces
     hbm_gb: Optional[float] = None
+    host_gb: Optional[float] = None  # host-RAM budget for the KV block tier
     shard_head: bool = True
     donate_kv: bool = True
     origin: str = "<plan>"

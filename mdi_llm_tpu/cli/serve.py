@@ -108,6 +108,17 @@ def build_parser():
                     "fraction otherwise).  1 = no pipelining")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable hash-based prefix block reuse")
+    ap.add_argument("--host-pool-mib", type=int, default=0,
+                    help="host-RAM KV block tier in MiB (0 = off): "
+                    "preemption victims swap their int8/fp blocks to "
+                    "pinned host slabs and resume without re-prefill "
+                    "(when the swap cost model beats recompute), and "
+                    "cold prefix chains spill there instead of being "
+                    "dropped (docs/perf.md 'Tiered KV')")
+    ap.add_argument("--host-link-gbps", type=float, default=None,
+                    help="host<->device link bandwidth (GB/s) for the "
+                    "swap-vs-recompute cost model (default: "
+                    "per-device-kind table in serving/host_tier.py)")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="engine-wide sampling temperature (0 = greedy)")
     ap.add_argument("--policy", default="fcfs",
@@ -215,6 +226,11 @@ def make_serving_config(args, admission_queue=None):
         temperature=args.temperature,
         kv_dtype="int8" if args.kv_dtype == "int8" else None,
         admission_queue=admission_queue,
+        host_pool_mib=args.host_pool_mib,
+        host_link_gbps=args.host_link_gbps,
+        # spill needs the prefix hash chains; keep the audit clean when
+        # the cache is off by degrading to a swap-only tier
+        host_prefix_spill=not args.no_prefix_cache,
     )
 
 
@@ -258,6 +274,12 @@ def preflight_serving(args, serving_cfg, origin):
             f" MiB{q_tag}{per_dev}",
             file=sys.stderr,
         )
+        if pool.get("host_blocks"):
+            print(
+                f"{origin}: host KV tier {pool['host_blocks']} blocks ~= "
+                f"{pool['host_pool_bytes'] / 2**20:.1f} MiB pinned host RAM",
+                file=sys.stderr,
+            )
     return report
 
 

@@ -499,6 +499,27 @@ class ServingConfig:
     # None → the replay engine's behavior (no bound; mdi-serve queues the
     # whole trace) and the server default of 4 × max_batch.
     admission_queue: Optional[int] = None
+    # host-RAM KV tier (serving/host_tier.py, docs/perf.md "Tiered KV"):
+    # a pinned host-side block store sized in MiB.  0 = no tier — today's
+    # recompute-on-preemption behavior, bit-for-bit.  When > 0, preempted
+    # victims SWAP their (possibly int8) blocks to host instead of
+    # recomputing (cost model permitting) and resume with zero re-prefill,
+    # and cold prefix-cache chains spill to host instead of being dropped.
+    host_pool_mib: int = 0
+    # estimated host↔device link bandwidth in GB/s for the swap-vs-
+    # recompute cost model.  None → the per-device-generation table
+    # (host_tier.HOST_LINK_GBPS) keyed on device_kind; 0 disables swapping
+    # entirely (and mdi-audit flags the dead tier: bad-host-tier).
+    host_link_gbps: Optional[float] = None
+    # blocks per jitted transfer quantum: swap-out gathers and restore
+    # scatters run in fixed-width batches of this many blocks (padded with
+    # the trash block), so the tier adds exactly TWO executables per
+    # engine regardless of sequence length — zero post-warmup recompiles.
+    swap_chunk_blocks: int = 8
+    # spill evicted prefix-cache chains to the host tier (needs
+    # prefix_caching; hits on spilled chains restore blocks and count as
+    # prefix_hits_host).  False = the tier serves preemption swaps only.
+    host_prefix_spill: bool = True
 
     def resolved_admission_queue(self) -> int:
         """The open-system admission-queue bound: `admission_queue` when
@@ -617,6 +638,40 @@ class ServingConfig:
         max_seq = int(min(max_seq_length or cfg.block_size, cfg.block_size))
         n_blocks = self.num_pool_blocks(max_seq)
         return n_blocks * self.block_bytes(cfg, dtype, tp=tp)["total_bytes"]
+
+    def num_host_blocks(self, cfg: "Config", dtype="bfloat16") -> int:
+        """Blocks the host tier holds: the `host_pool_mib` budget divided
+        by the FULL (unsharded, tp=1) `block_bytes` — the host store keeps
+        whole blocks even when the HBM pool shards over tp, so a block
+        restored on a differently-sized mesh is still complete.  0 when
+        the tier is off."""
+        if self.host_pool_mib <= 0:
+            return 0
+        per_block = self.block_bytes(cfg, dtype, tp=1)["total_bytes"]
+        if per_block <= 0:
+            return 0
+        return int(self.host_pool_mib * 2**20) // per_block
+
+    def host_pool_bytes(self, cfg: "Config", dtype="bfloat16") -> int:
+        """Host-RAM bytes the tier's block store actually allocates:
+        whole blocks only (the MiB budget rounds DOWN to block granularity)
+        — byte-exact against the live `host_tier.HostBlockStore` slabs,
+        the same contract `pool_bytes` keeps with the HBM pool.  The
+        mdi-audit `kv_pool` breakdown and `--host-gb` check read this."""
+        n = self.num_host_blocks(cfg, dtype)
+        return n * self.block_bytes(cfg, dtype, tp=1)["total_bytes"]
+
+    def resolved_host_link_gbps(self, device_kind: Optional[str] = None) -> float:
+        """Host↔device link bandwidth (GB/s) the swap cost model uses:
+        `host_link_gbps` when set, else the per-device-generation table in
+        `serving.host_tier.HOST_LINK_GBPS` keyed on `device_kind` (its
+        conservative default covers CPU/unknown).  0 means swapping can
+        never win — mdi-audit flags a tier configured that way."""
+        if self.host_link_gbps is not None:
+            return float(self.host_link_gbps)
+        from mdi_llm_tpu.serving.host_tier import lookup_host_link_gbps
+
+        return lookup_host_link_gbps(device_kind)
 
 
 def _yaml_scalar(v: Any) -> str:

@@ -75,6 +75,14 @@ __all__ = [
 
 LATENCY_METRICS = ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")
 
+# host-tier restore-issue wait histogram bounds, in MILLISECONDS (restores
+# are issued at host-sync boundaries and hidden behind the next dispatch,
+# so the interesting range sits well under the request-latency buckets)
+RESTORE_WAIT_BUCKETS_MS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0,
+)
+
 
 class ServingObserver:
     """Observability hub for one serving engine (or several sharing it).
@@ -186,13 +194,19 @@ class ServingObserver:
                              "requests queued").inc()
 
     def request_admitted(self, rid: str, slot: int, admit_order: int,  # mdi-thread: engine
-                         n_cached: int = 0, resumed: bool = False) -> None:
+                         n_cached: int = 0, resumed: bool = False,
+                         restored: bool = False) -> None:
         self.tracer.request_admitted(rid, slot, admit_order,
-                                     n_cached=n_cached, resumed=resumed)
+                                     n_cached=n_cached, resumed=resumed,
+                                     restored=restored)
         name = ("serving_requests_resumed_total" if resumed
                 else "serving_requests_admitted_total")
         self.metrics.counter(name, "admissions into decode slots").inc()
-        if n_cached:
+        if restored:
+            self.metrics.counter("serving_requests_restored_total",
+                                 "resumes served from host-tier swap "
+                                 "payloads (zero re-prefill)").inc()
+        if n_cached and not restored:
             self.metrics.counter("serving_prefix_cached_tokens_total",
                                  "prompt tokens served from the prefix "
                                  "cache").inc(n_cached)
@@ -207,10 +221,40 @@ class ServingObserver:
                              "arrivals rejected by admission "
                              "backpressure").inc()
 
-    def request_preempted(self, rid: str, n_generated: int) -> None:  # mdi-thread: engine
-        self.tracer.request_preempted(rid, n_generated)
+    def request_preempted(self, rid: str, n_generated: int,  # mdi-thread: engine
+                          swapped: bool = False) -> None:
+        self.tracer.request_preempted(rid, n_generated, swapped=swapped)
         self.metrics.counter("serving_preemptions_total",
                              "recompute-style preemptions").inc()
+        if swapped:
+            self.metrics.counter("serving_preemptions_swapped_total",
+                                 "preemptions resolved by host-tier swap "
+                                 "instead of recompute").inc()
+
+    # -- host-tier transfers (serving/host_tier.py) --------------------------
+
+    def tier_swap_out(self, n_blocks: int, nbytes: int) -> None:  # mdi-thread: engine
+        """One victim's blocks gathered toward host slots (bytes counted
+        at issue time; materialization rides a later sync boundary)."""
+        self.metrics.counter("serving_swap_out_bytes_total",
+                             "KV bytes swapped HBM → host").inc(nbytes)
+        self.metrics.counter("serving_swap_out_blocks_total",
+                             "KV blocks swapped HBM → host").inc(n_blocks)
+
+    def tier_swap_in(self, n_blocks: int, nbytes: int) -> None:  # mdi-thread: engine
+        self.metrics.counter("serving_swap_in_bytes_total",
+                             "KV bytes restored host → HBM").inc(nbytes)
+        self.metrics.counter("serving_swap_in_blocks_total",
+                             "KV blocks restored host → HBM").inc(n_blocks)
+
+    def restore_wait(self, seconds: float) -> None:  # mdi-thread: engine
+        """Host time spent issuing one restore batch (upload + scatter
+        enqueue — the part not hidden behind the next dispatch)."""
+        self.metrics.histogram(
+            "serving_restore_wait_ms",
+            "host-side wait per host→HBM restore issue",
+            buckets=RESTORE_WAIT_BUCKETS_MS,
+        ).observe(seconds * 1e3)
 
     def prefill_chunk(self, rid: str, n_tokens: int) -> None:  # mdi-thread: engine
         self.tracer.prefill_chunk(rid, n_tokens, self.now)

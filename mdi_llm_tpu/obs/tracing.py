@@ -168,7 +168,8 @@ class TraceRecorder:
         self.instant("submitted", now, _PID_REQUESTS, 0, {"rid": rid})
 
     def request_admitted(self, rid: str, slot: int, admit_order: int,
-                         n_cached: int = 0, resumed: bool = False) -> None:
+                         n_cached: int = 0, resumed: bool = False,
+                         restored: bool = False) -> None:
         now = self.clock()
         t = self.open.get(rid)
         if t is None:  # admitted without a submit record: synthesize one
@@ -178,17 +179,23 @@ class TraceRecorder:
             t.admitted_ts = now  # queue-wait measures the FIRST admission
             t.admit_order = admit_order
         t.slot = slot
-        self.instant("resumed" if resumed else "admitted", now,
+        # host-tier resumes (serving/host_tier.py) render as their own
+        # lifecycle edge: the KV came back from host slots, not re-prefill
+        name = ("resumed_restored" if restored
+                else "resumed" if resumed else "admitted")
+        self.instant(name, now,
                      _PID_REQUESTS, max(0, t.admit_order),
                      {"rid": rid, "slot": slot, "admit_order": admit_order,
                       "prefix_cached_tokens": n_cached})
 
-    def request_preempted(self, rid: str, n_generated: int) -> None:
+    def request_preempted(self, rid: str, n_generated: int,
+                          swapped: bool = False) -> None:
         now = self.clock()
         t = self.open.get(rid)
         if t is not None:
             t.preemptions += 1
-        self.instant("preempted", now, _PID_REQUESTS,
+        self.instant("preempted_swapped" if swapped else "preempted",
+                     now, _PID_REQUESTS,
                      max(0, t.admit_order) if t else 0,
                      {"rid": rid, "n_generated": n_generated})
 

@@ -379,6 +379,8 @@ class ServingFrontend:
             for i, (req, _toks) in enumerate(sched.preempted):
                 if req.rid == rid:
                     del sched.preempted[i]
+                    # host tier: a swapped-out entry also holds host slots
+                    sched.drop_swap_record(rid)
                     break
             # live in a slot: retire, releasing its blocks
             for seq in sched.running():

@@ -215,6 +215,16 @@ class ServingStats:
     # fixed HBM (the serving-cb-int8 bench rung reads it off this field)
     resident_peak: int = 0
     prefix_cache_hits: int = 0  # blocks reused copy-free
+    # host-RAM tier (serving/host_tier.py): folded from the tier at run
+    # end.  All-zero when no tier is configured (host_pool_mib=0), so the
+    # one stats schema serves tiered and untiered runs alike.
+    swaps_out: int = 0  # preemptions resolved by swap instead of recompute
+    swaps_in: int = 0  # resumes restored from host payloads (no re-prefill)
+    swap_out_bytes: int = 0
+    swap_in_bytes: int = 0
+    prefix_hits_host: int = 0  # prefix blocks restored from spilled chains
+    restore_issue_s: float = 0.0  # host time issuing restores (the part
+    # NOT hidden behind the next dispatch — the restore-overlap residual)
     wall_s: float = 0.0
     decode_s: float = 0.0
     prefill_s: float = 0.0
@@ -306,6 +316,12 @@ class ServingStats:
             "kv_block_utilization_peak": round(self.kv_utilization_peak, 4),
             "prefix_cache_hits": self.prefix_cache_hits,
             "preemptions": self.preemptions,
+            "swaps_out": self.swaps_out,
+            "swaps_in": self.swaps_in,
+            "swap_out_bytes": self.swap_out_bytes,
+            "swap_in_bytes": self.swap_in_bytes,
+            "prefix_hits_host": self.prefix_hits_host,
+            "restore_issue_s": round(self.restore_issue_s, 4),
             "resident_peak": self.resident_peak,
             "requests_rejected": self.requests_rejected,
             "queue_depth_peak": self.queue_depth_peak,
@@ -326,6 +342,12 @@ class ServingEngine:
     and the SAME calls serve sharded (pool KV groups split over tp; see
     the module docstring); token streams are identical to single-device.
     """
+
+    # which axis of a pool leaf indexes blocks — 1 for the flat
+    # (L, NB, ...) payload/scale leaves, 2 for the pipeline engine's
+    # stage-stacked (S, l_max, NB, ...) layout.  The host tier's
+    # fetch/restore fns and the host store's slab layout both key off it.
+    _kv_block_axis = 1
 
     def __init__(self, gen: Generator, serving: ServingConfig, obs=None,
                  policy=None):
@@ -428,6 +450,18 @@ class ServingEngine:
         )
         self.scheduler.observer = obs  # lifecycle edges report from there
         self._kv = self._init_pool(num_blocks, bs)
+        # host-RAM tier (serving/host_tier.py): host_pool_mib = 0 keeps
+        # every table, hook and the compile set bit-for-bit untouched.
+        # Abstract engines (mdi-ir/mdi-flow) never allocate slabs — the
+        # tier's reachable fetch/restore signatures derive from the
+        # ServingConfig alone.
+        self.host_tier = None
+        self._host_block_bytes = 0
+        # gather snapshots issued but not yet copied into host slabs:
+        # (host slots, on-device per-leaf arrays, live block count)
+        self._pending_swaps: List[Tuple[List[int], Any, int]] = []
+        if serving.host_pool_mib > 0 and not getattr(gen, "abstract", False):
+            self._init_host_tier()
         # persistent host-side block table, updated incrementally as blocks
         # are appended / slots reassigned — rebuilding the full
         # (max_batch, max_blocks_per_seq) ndarray per decode dispatch was
@@ -530,6 +564,231 @@ class ServingEngine:
         return self.gen._place_paged_kv(transformer.init_paged_kv_cache(
             self.gen.cfg, num_blocks, bs, dtype=self._pool_dtype
         ))
+
+    # -- host-RAM tier (serving/host_tier.py) --------------------------------
+
+    def _kv_leaf_shapes(self) -> List[Tuple[Tuple[int, ...], Any]]:
+        """(shape, dtype) per pool leaf in tree-flatten order — the slab
+        template the host store mirrors and the payload signature the
+        fetch/restore executables move."""
+        return [
+            (tuple(l.shape), np.dtype(l.dtype))
+            for l in jax.tree_util.tree_leaves(self._kv)
+        ]
+
+    def _init_host_tier(self) -> None:
+        """Build the host block store + cost model and install the tier
+        hooks on the pool and scheduler.  Slab shapes come from the LIVE
+        pool leaves (so int8 payload+scale, fp, tp-sharded and pp-stacked
+        layouts all round-trip byte-identically); slot count divides the
+        `host_pool_mib` budget by the per-block byte footprint — for the
+        flat layout exactly `ServingConfig.num_host_blocks`, the byte
+        contract mdi-audit's `host_pool_bytes` breakdown pins."""
+        from mdi_llm_tpu.serving.host_tier import (
+            HostBlockStore,
+            HostTier,
+            SwapCostModel,
+        )
+
+        ba = self._kv_block_axis
+        leaf_shapes = self._kv_leaf_shapes()
+        per_block = sum(
+            np.dtype(d).itemsize
+            * int(np.prod(s[:ba] + s[ba + 1:], dtype=np.int64))
+            for s, d in leaf_shapes
+        )
+        self._host_block_bytes = per_block
+        num_slots = (self.cfg.host_pool_mib * 2**20) // max(1, per_block)
+        device_kind = None
+        if jax.default_backend() == "tpu":
+            device_kind = jax.devices()[0].device_kind
+        store = HostBlockStore(leaf_shapes, ba, num_slots)
+        self.host_tier = HostTier(
+            store,
+            SwapCostModel(
+                link_gbps=self.cfg.resolved_host_link_gbps(device_kind)
+            ),
+            # spilling rides the hash chain: without prefix_caching there
+            # is no chain to key the spilled blocks (mdi-audit's
+            # bad-host-tier check flags the config asking for both)
+            prefix_spill=(
+                self.cfg.host_prefix_spill and self.cfg.prefix_caching
+            ),
+        )
+        self.pool.host = self.host_tier
+        if self.host_tier.prefix_spill:
+            self.pool.spill_hook = self._spill_block
+            self.pool.restore_hook = self._restore_spilled
+        self.scheduler.swap_out_hook = self._swap_out
+        self.scheduler.swap_in_hook = self._swap_in
+        self.scheduler.swap_drop_hook = self._swap_drop
+
+    def _issue_fetch(self, blocks: List[int], slots: List[int]) -> None:
+        """Enqueue gather snapshots of `blocks` toward host `slots` in
+        fixed-width chunks (ONE fetch executable per engine, whatever the
+        victim size; short tails pad with reads of block 0).  Device
+        in-order execution snapshots the payload before any later
+        dispatch's writes — the blocks may return to the free list
+        immediately.  The device→host copy materializes at the next
+        host-sync boundary (`_drain_swaps`)."""
+        W = max(1, self.cfg.swap_chunk_blocks)
+        fetch = self._fetch_blocks_fn(W)
+        for i in range(0, len(blocks), W):
+            chunk = blocks[i : i + W]
+            idx = np.zeros((W,), np.int32)
+            idx[: len(chunk)] = chunk
+            out = fetch(self._kv, jnp.asarray(idx))
+            self._pending_swaps.append((slots[i : i + W], out, len(chunk)))
+
+    def _drain_swaps(self) -> None:
+        """Materialize every pending gather snapshot into its host slots.
+        Runs at host-sync boundaries (each step, and before any host-slab
+        read) so the device→host copies overlap dispatched compute; the
+        measured rate feeds the cost model's link-BW estimate."""
+        if not self._pending_swaps:
+            return
+        tier = self.host_tier
+        t0 = time.perf_counter()
+        nbytes = 0
+        for slots, out, n in self._pending_swaps:
+            arrays = [
+                np.asarray(l)  # mdi-lint: disable=host-sync -- the swap tier's explicit device→host copy, drained only at host-sync boundaries
+                for l in jax.tree_util.tree_leaves(out)
+            ]
+            tier.store.write(slots, arrays)
+            nbytes += n * self._host_block_bytes
+        self._pending_swaps.clear()
+        tier.cost_model.observe_transfer(nbytes, time.perf_counter() - t0)
+
+    def _swap_out(self, seq: SequenceState):
+        """Scheduler hook at `preempt_latest`, called while the victim
+        still owns its blocks: decide swap-vs-recompute from the cost
+        model, claim host slots, and enqueue the gather.  Returns the
+        SwapRecord riding the preempted entry, or None for the historical
+        recompute path."""
+        from mdi_llm_tpu.serving.host_tier import SwapRecord
+
+        tier = self.host_tier
+        if tier is None or seq.fed <= 0:
+            return None
+        n_blocks = self.pool.blocks_needed(seq.fed)
+        nbytes = n_blocks * self._host_block_bytes
+        # recompute would re-prefill every fed token on resume
+        if not tier.cost_model.should_swap(nbytes, seq.fed):
+            return None
+        slots = tier.alloc_for_swap(n_blocks)
+        if slots is None:
+            return None
+        self._issue_fetch(seq.blocks[:n_blocks], slots)
+        tier.swaps_out += 1
+        tier.swap_out_bytes += nbytes
+        if self.obs is not None:
+            self.obs.tier_swap_out(n_blocks, nbytes)
+        return SwapRecord(slots=slots, n_tokens=seq.fed, nbytes=nbytes)
+
+    def _swap_in(self, record, blocks: List[int]) -> None:
+        """Scheduler hook at swapped-resume admission: restore the
+        record's payload into freshly allocated HBM `blocks` through the
+        fixed-width donated scatter (padding targets the write-only trash
+        block 0).  The restores are ENQUEUED here and overlap behind the
+        resumed sequence's next dispatch — the data dependency through
+        the donated pool orders them before any later read/write."""
+        assert len(blocks) == len(record.slots)
+        tier = self.host_tier
+        self._drain_swaps()  # the record's own gather may still be pending
+        t0 = time.perf_counter()
+        W = max(1, self.cfg.swap_chunk_blocks)
+        restore = self._restore_blocks_fn(W)
+        arrays = tier.store.read(record.slots)
+        for i in range(0, len(blocks), W):
+            chunk = blocks[i : i + W]
+            idx = np.zeros((W,), np.int32)  # padding scatters to trash
+            idx[: len(chunk)] = chunk
+            payload = []
+            for arr in arrays:
+                rows = arr[i : i + W]
+                if rows.shape[0] < W:
+                    pad = np.zeros(
+                        (W - rows.shape[0],) + rows.shape[1:], rows.dtype
+                    )
+                    rows = np.concatenate([rows, pad], axis=0)
+                payload.append(jnp.asarray(rows))
+            kv = self._kv
+            self._kv = None  # donated
+            try:
+                self._kv = restore(kv, jnp.asarray(idx), payload)
+            except Exception:
+                self._kv = kv  # see _run_mixed: keep failures diagnosable
+                raise
+        tier.store.release(record.slots)
+        tier.swaps_in += 1
+        tier.swap_in_bytes += record.nbytes
+        dt = time.perf_counter() - t0
+        self.stats.restore_issue_s += dt
+        if self.obs is not None:
+            self.obs.tier_swap_in(len(blocks), record.nbytes)
+            self.obs.restore_wait(dt)
+
+    def _swap_drop(self, record) -> None:
+        """Release a swap record's host slots without restoring (the
+        frontend cancelled the preempted request)."""
+        self._drain_swaps()  # its gather may still target those slots
+        self.host_tier.store.release(record.slots)
+
+    def _spill_block(self, blk: int, chain_hash: int) -> None:
+        """Pool hook as a cached chain block is evicted: copy it to a
+        host slot instead of dropping it.  The gather snapshots the bytes
+        before the block's new owner can write (in-order execution), so
+        eviction stays copy-free on the HBM side."""
+        tier = self.host_tier
+        slot = tier.alloc_for_spill()
+        if slot is None:
+            return
+        self._issue_fetch([blk], [slot])
+        tier.record_spill(chain_hash, slot)
+
+    def _restore_spilled(self, chain_hash: int):
+        """Pool hook on a prefix-cache miss: if the chain spilled to the
+        host tier, claim a fresh HBM block (refcount 1), enqueue its
+        payload restore, and hand it back to `match_prefix` — the hit
+        counts as `prefix_hits_host`.  None when the hash is not spilled
+        or the pool has no block to spare (the host copy is dropped:
+        a chain the pool cannot re-admit is dead weight in the store)."""
+        tier = self.host_tier
+        slot = tier.take_spill(chain_hash)
+        if slot is None:
+            return None
+        got = self.pool.alloc(1)
+        if got is None:
+            tier.store.release([slot])
+            return None
+        self._drain_swaps()  # the spill's gather may still be in flight
+        t0 = time.perf_counter()
+        W = max(1, self.cfg.swap_chunk_blocks)
+        restore = self._restore_blocks_fn(W)
+        arrays = tier.store.read([slot])
+        idx = np.zeros((W,), np.int32)  # padding scatters to trash
+        idx[0] = got[0]
+        payload = []
+        for arr in arrays:
+            pad = np.zeros((W - 1,) + arr.shape[1:], arr.dtype)
+            payload.append(jnp.asarray(np.concatenate([arr, pad], axis=0)))
+        kv = self._kv
+        self._kv = None  # donated
+        try:
+            self._kv = restore(kv, jnp.asarray(idx), payload)
+        except Exception:
+            self._kv = kv  # see _run_mixed: keep failures diagnosable
+            raise
+        tier.store.release([slot])
+        tier.swaps_in += 1
+        tier.swap_in_bytes += self._host_block_bytes
+        dt = time.perf_counter() - t0
+        self.stats.restore_issue_s += dt
+        if self.obs is not None:
+            self.obs.tier_swap_in(1, self._host_block_bytes)
+            self.obs.restore_wait(dt)
+        return got[0]
 
     # -- compiled phases -----------------------------------------------------
 
@@ -706,6 +965,54 @@ class ServingEngine:
             self._fns[key_] = verify
         return self._fns[key_]
 
+    def _fetch_blocks_fn(self, W: int):
+        """Gather `W` pool blocks into block-LEADING per-leaf arrays —
+        the host tier's swap-out/spill snapshot (`HostBlockStore.write`'s
+        exact layout).  Fixed width: every transfer quantizes to
+        `swap_chunk_blocks`, so the tier adds exactly this one extra
+        executable however many victims swap (the zero-post-warmup-
+        recompile contract).  Non-donating — the pool stays live; short
+        tails pad with reads of block 0 and are dropped host-side."""
+        key_ = ("fetch", W)
+        if key_ not in self._fns:
+            ba = self._kv_block_axis  # see _mixed_fn: no self in closures
+
+            @jax.jit
+            def fetch(kv, idx):
+                return jax.tree_util.tree_map(
+                    lambda l: jnp.moveaxis(jnp.take(l, idx, axis=ba), ba, 0),
+                    kv,
+                )
+
+            self._fns[key_] = fetch
+        return self._fns[key_]
+
+    def _restore_blocks_fn(self, W: int):
+        """Scatter `W` block-leading payload rows back into the pool at
+        `idx` — the host tier's restore half, donating the pool like
+        every serving dispatch so the blocks land in place.  Padding rows
+        target the write-only trash block 0.  `payload` is the pool's
+        leaf list in tree-flatten order (`_kv_leaf_shapes`)."""
+        key_ = ("restore", W)
+        if key_ not in self._fns:
+            ba = self._kv_block_axis  # see _mixed_fn: no self in closures
+            kv_sharding = self._kv_sharding_pair
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def restore(kv, idx, payload):
+                leaves, treedef = jax.tree_util.tree_flatten(kv)
+                out = [
+                    l.at[(slice(None),) * ba + (idx,)].set(
+                        jnp.moveaxis(p, 0, ba)
+                    )
+                    for l, p in zip(leaves, payload)
+                ]
+                kv = jax.tree_util.tree_unflatten(treedef, out)
+                return _pin_kv(kv, kv_sharding)
+
+            self._fns[key_] = restore
+        return self._fns[key_]
+
     # -- static enumeration (analysis/ir.py) ---------------------------------
 
     def reachable_signatures(self) -> List[Tuple[str, Tuple[int, ...]]]:
@@ -736,6 +1043,13 @@ class ServingEngine:
             sigs.append(("decode_chunk", (B, self.cfg.decode_chunk)))
         else:
             sigs.append(("decode", (B,)))
+        if self.cfg.host_pool_mib > 0:
+            # the host tier's fixed-width transfer pair (swap-out/spill
+            # gather + restore scatter) — reachable from any preemption
+            # or prefix miss once a tier is configured
+            W = max(1, self.cfg.swap_chunk_blocks)
+            sigs.append(("fetch", (W,)))
+            sigs.append(("restore", (W,)))
         return sigs
 
     def enumerate_executables(self) -> List[Any]:
@@ -805,6 +1119,27 @@ class ServingEngine:
                     "verify", k, self._verify_fn(B, T), args, None, (2,),
                     dict(roles),
                 ))
+            elif label in ("fetch", "restore"):
+                # the host tier's transfer pair moves pool blocks, not
+                # model activations: kv rides at argnum 0 for both
+                W = k[0]
+                ba = self._kv_block_axis
+                payload = [
+                    sds((W,) + tuple(l.shape[:ba]) + tuple(l.shape[ba + 1:]),
+                        l.dtype)
+                    for l in jax.tree_util.tree_leaves(kv)
+                ]
+                if label == "fetch":
+                    specs.append(ExecutableSpec(
+                        "fetch", k, self._fetch_blocks_fn(W),
+                        (kv, sds((W,), i32)), None, (), {0: "kv"},
+                    ))
+                else:
+                    specs.append(ExecutableSpec(
+                        "restore", k, self._restore_blocks_fn(W),
+                        (kv, sds((W,), i32), payload), None, (0,),
+                        {0: "kv"},
+                    ))
         return specs
 
     # -- device-side introspection (obs/device.py) ---------------------------
@@ -917,6 +1252,10 @@ class ServingEngine:
         ]
         if not live:
             return
+        # prefill tokens this step feeds — measured against the step's
+        # wall time below, they EWMA-correct the swap cost model's
+        # recompute-rate prior toward the actual machine
+        n_prefill_toks = sum(n for s, n in live if s.needs_prefill)
         B = self.scheduler.max_batch
         T = self.token_budget
         trash_pos = self.max_blocks_per_seq * self.pool.block_size
@@ -1010,6 +1349,10 @@ class ServingEngine:
                 self._emit(seq, int(nxt[seq.slot]))
         if any_decode:
             self.stats.decode_steps += 1
+        if self.host_tier is not None and n_prefill_toks:
+            self.host_tier.cost_model.observe_prefill(
+                n_prefill_toks, time.perf_counter() - t0
+            )
         self.stats.prefill_s += time.perf_counter() - t0
 
     def _queue_depth(self) -> int:
@@ -1383,6 +1726,10 @@ class ServingEngine:
             self._run_decode_chunk(action[1])
         else:
             self._run_decode(action[1])
+        # host tier: swap gathers issued by this step's preemptions/spills
+        # materialize now, their device→host copy overlapped behind the
+        # dispatch above (the step's own sync already paid the wait)
+        self._drain_swaps()
         return True
 
     def run(self, stream_cb=None,  # mdi-thread: engine
@@ -1411,6 +1758,14 @@ class ServingEngine:
         finally:
             self.stats.preemptions = self.scheduler.preemptions
             self.stats.prefix_cache_hits = self.pool.prefix_hits
+            if self.host_tier is not None:
+                self._drain_swaps()  # park in-flight snapshots in the slabs
+                tier = self.host_tier
+                self.stats.swaps_out = tier.swaps_out
+                self.stats.swaps_in = tier.swaps_in
+                self.stats.swap_out_bytes = tier.swap_out_bytes
+                self.stats.swap_in_bytes = tier.swap_in_bytes
+                self.stats.prefix_hits_host = self.pool.prefix_hits_host
             self.stats.wall_s += time.perf_counter() - t0
             self._stream_cb = None
             if self.obs is not None:

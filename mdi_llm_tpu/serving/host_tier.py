@@ -1,0 +1,286 @@
+"""Host-RAM KV tier: a pinned block store behind the paged HBM pool.
+
+The serving scale ceiling is KV bytes resident in HBM: pool exhaustion
+triggers recompute preemption (`scheduler.preempt_latest` — generated
+work thrown away and re-prefilled) and the hash-chain prefix cache drops
+cold chains at capacity (`kv_pool.KVPool._take`).  This module adds the
+tier both paths fall back to instead:
+
+- **Swap on preemption**: a victim's blocks copy to host slots through a
+  fixed-width jitted gather (`engine._fetch_blocks_fn`), the HBM blocks
+  return to the free list immediately, and on resume the payload
+  restores through explicit `jax.device_put`s + a donated scatter
+  overlapped behind the next dispatch — the sequence re-enters
+  mid-generation with zero re-prefill.
+- **Prefix spill**: chains evicted from the HBM prefix cache land in
+  host slots keyed by the SAME chain hash; a later `match_prefix` hit on
+  a spilled block restores it and counts as `prefix_hits_host`.
+
+Everything here is host-side bookkeeping over numpy slabs — no jax
+imports, no device placement.  The device interaction (gather/scatter
+executables, explicit transfers at host-sync boundaries) stays in
+`serving/engine.py`; the split mirrors `KVPool`, whose tables are
+likewise device-blind (docs/perf.md "Tiered KV").
+
+Content state walks `hbm → in-flight → host → hbm`: "in-flight" is a
+gather snapshot whose device→host copy has not materialized yet (the
+HBM blocks are already free — the snapshot owns the bytes); the engine
+materializes pending snapshots at the next host-sync boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "HOST_LINK_GBPS",
+    "DEFAULT_HOST_LINK_GBPS",
+    "lookup_host_link_gbps",
+    "SwapCostModel",
+    "HostBlockStore",
+    "HostTier",
+    "SwapRecord",
+]
+
+# Host↔device link bandwidth priors in GB/s per device generation: PCIe
+# gen3-x16-ish for v4/v5e boards, gen4/5 for v5p/v6e, a conservative
+# default for CPU and unknown kinds.  Priors only — the cost model
+# EWMA-corrects toward measured transfer rates as swaps happen.
+HOST_LINK_GBPS: Dict[str, float] = {
+    "TPU v4": 16.0,
+    "TPU v5 lite": 16.0,
+    "TPU v5e": 16.0,
+    "TPU v5p": 32.0,
+    "TPU v5": 32.0,
+    "TPU v6 lite": 32.0,
+    "TPU v6e": 32.0,
+    "TPU v6": 32.0,
+}
+DEFAULT_HOST_LINK_GBPS = 8.0
+
+
+def lookup_host_link_gbps(device_kind: Optional[str]) -> float:
+    """Longest-prefix match of `device_kind` against the generation
+    table; unknown kinds (CPU, new TPUs) get the conservative default."""
+    if device_kind:
+        best = ""
+        for kind in HOST_LINK_GBPS:
+            if device_kind.startswith(kind) and len(kind) > len(best):
+                best = kind
+        if best:
+            return HOST_LINK_GBPS[best]
+    return DEFAULT_HOST_LINK_GBPS
+
+
+@dataclasses.dataclass
+class SwapCostModel:
+    """Swap-vs-recompute decision for one preemption victim.
+
+    Swapping a victim costs a round trip of its block bytes over the
+    host link; recomputing costs re-prefilling every token it had fed.
+    Both sides start from priors (`link_gbps` from the device-generation
+    table, `prefill_tokens_per_s` from a deliberately pessimistic
+    default) and EWMA-correct toward measured rates, so the decision
+    tracks the actual machine rather than the table.  `clock` is
+    injectable for deterministic unit tests."""
+
+    link_gbps: float
+    prefill_tokens_per_s: float = 2000.0
+    ewma: float = 0.25
+    clock: Callable[[], float] = time.perf_counter
+
+    def swap_seconds(self, nbytes: int) -> float:
+        """One-way transfer time for `nbytes` at the estimated link BW."""
+        if self.link_gbps <= 0:
+            return float("inf")
+        return nbytes / (self.link_gbps * 1e9)
+
+    def recompute_seconds(self, refill_tokens: int) -> float:
+        return refill_tokens / max(self.prefill_tokens_per_s, 1e-9)
+
+    def should_swap(self, nbytes: int, refill_tokens: int) -> bool:
+        """True when the swap round trip (out + back in) beats
+        re-prefilling `refill_tokens`.  A zero/negative-BW link can never
+        win — mdi-audit flags a tier configured that way (bad-host-tier)."""
+        if self.link_gbps <= 0:
+            return False
+        return 2.0 * self.swap_seconds(nbytes) < self.recompute_seconds(
+            refill_tokens
+        )
+
+    def observe_transfer(self, nbytes: int, seconds: float) -> None:
+        """Fold one measured host↔device transfer into the BW estimate."""
+        if seconds <= 0 or nbytes <= 0:
+            return
+        measured = nbytes / (seconds * 1e9)
+        self.link_gbps += self.ewma * (measured - self.link_gbps)
+
+    def observe_prefill(self, tokens: int, seconds: float) -> None:
+        """Fold one measured prefill burst into the recompute estimate."""
+        if seconds <= 0 or tokens <= 0:
+            return
+        measured = tokens / seconds
+        self.prefill_tokens_per_s += self.ewma * (
+            measured - self.prefill_tokens_per_s
+        )
+
+
+class HostBlockStore:
+    """Fixed-capacity pinned block store: one numpy slab per pool leaf.
+
+    The slab layout is derived from the LIVE pool's leaf shapes with the
+    block axis hoisted to the front — slot i of leaf j is
+    ``slabs[j][i]``, one block's worth of that leaf (full, unsharded
+    bytes: under tp the pool leaves' GLOBAL shapes feed the template, so
+    a stored block is complete regardless of the mesh it left).  Total
+    `nbytes` is exactly ``num_slots × ServingConfig.block_bytes(tp=1)``
+    for the flat pool layout — the byte-exactness contract the mdi-audit
+    `host_pool_bytes` breakdown pins.
+
+    Allocation mirrors `KVPool`: LIFO free list, all-or-nothing
+    `alloc`."""
+
+    def __init__(
+        self,
+        leaf_shapes: Sequence[Tuple[Tuple[int, ...], Any]],
+        block_axis: int,
+        num_slots: int,
+    ):
+        self.block_axis = int(block_axis)
+        self.num_slots = int(num_slots)
+        self.slabs: List[np.ndarray] = []
+        for shape, dtype in leaf_shapes:
+            ba = self.block_axis
+            per_block = tuple(shape[:ba]) + tuple(shape[ba + 1:])
+            self.slabs.append(
+                np.zeros((self.num_slots,) + per_block, dtype=np.dtype(dtype))
+            )
+        self._free: List[int] = list(range(self.num_slots))
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self.slabs)
+
+    @property
+    def used(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing: n slots or None (no partial grabs to unwind)."""
+        if n < 0 or n > len(self._free):
+            return None
+        slots = [self._free.pop() for _ in range(n)]
+        return slots
+
+    def release(self, slots: Sequence[int]) -> None:
+        for s in slots:
+            self._free.append(int(s))
+
+    def write(self, slots: Sequence[int], arrays: Sequence[np.ndarray]) -> None:
+        """Store per-leaf payloads (block axis LEADING: row k is block k)
+        into `slots`; payload rows past len(slots) are transfer padding
+        and are dropped."""
+        idx = np.asarray(slots, dtype=np.int64)
+        for slab, arr in zip(self.slabs, arrays):
+            slab[idx] = arr[: len(slots)]
+
+    def read(self, slots: Sequence[int]) -> List[np.ndarray]:
+        """Per-leaf payloads for `slots`, block axis leading — the inverse
+        of `write` (copies: the slabs stay valid while restores are in
+        flight)."""
+        idx = np.asarray(slots, dtype=np.int64)
+        return [slab[idx] for slab in self.slabs]
+
+
+@dataclasses.dataclass
+class SwapRecord:
+    """What a swapped-out victim needs to resume: which host slots hold
+    its blocks (block-chain order) and how many tokens of KV they cover
+    (`n_tokens` = the victim's fed position count; the LAST slot is a
+    partial block unless n_tokens is block-aligned)."""
+
+    slots: List[int]
+    n_tokens: int
+    nbytes: int
+
+
+class HostTier:
+    """Bookkeeping over one `HostBlockStore`: swap records vs spilled
+    prefix blocks, with swaps taking priority for capacity (evicting
+    spilled blocks LRU when the free list runs dry — state beats cache).
+
+    Purely host-side; the engine owns every device interaction and calls
+    down here only at host-sync boundaries."""
+
+    def __init__(self, store: HostBlockStore, cost_model: SwapCostModel,
+                 prefix_spill: bool = True):
+        self.store = store
+        self.cost_model = cost_model
+        self.prefix_spill = bool(prefix_spill)
+        # chain hash -> host slot, LRU order (oldest first) — the spilled
+        # shadow of KVPool._evictable
+        self.spilled: "OrderedDict[int, int]" = OrderedDict()
+        # counters the engine folds into ServingStats / obs at run end
+        self.swaps_out = 0
+        self.swaps_in = 0
+        self.swap_out_bytes = 0
+        self.swap_in_bytes = 0
+        self.spills = 0
+
+    # -- capacity ----------------------------------------------------------
+
+    def alloc_for_swap(self, n: int) -> Optional[List[int]]:
+        """n slots for a preemption swap, evicting spilled prefix blocks
+        (oldest first) when the free list alone cannot cover it."""
+        while self.store.available < n and self.spilled:
+            _, slot = self.spilled.popitem(last=False)
+            self.store.release([slot])
+        return self.store.alloc(n)
+
+    def alloc_for_spill(self) -> Optional[int]:
+        """One slot for a spilled prefix block: free list first, else
+        recycle the oldest spilled block — spills never displace swap
+        records."""
+        slots = self.store.alloc(1)
+        if slots is None and self.spilled:
+            _, slot = self.spilled.popitem(last=False)
+            return slot
+        return slots[0] if slots else None
+
+    # -- spilled-prefix map ------------------------------------------------
+
+    def record_spill(self, chain_hash: int, slot: int) -> None:
+        self.spilled[chain_hash] = slot
+        self.spilled.move_to_end(chain_hash)
+        self.spills += 1
+
+    def lookup_spill(self, chain_hash: int) -> Optional[int]:
+        return self.spilled.get(chain_hash)
+
+    def take_spill(self, chain_hash: int) -> Optional[int]:
+        """Claim a spilled block's slot for restore; the caller releases
+        the slot once the payload is back in HBM."""
+        return self.spilled.pop(chain_hash, None)
+
+    def snapshot(self) -> Dict[str, int]:
+        """Tier gauges for `KVPool.snapshot` / obs: slot occupancy plus
+        the lifetime swap/spill counters."""
+        return {
+            "host_blocks": self.store.num_slots,
+            "host_used_blocks": self.store.used,
+            "host_spilled_blocks": len(self.spilled),
+            "host_pool_bytes": self.store.nbytes,
+            "swaps_out": self.swaps_out,
+            "swaps_in": self.swaps_in,
+            "swap_out_bytes": self.swap_out_bytes,
+            "swap_in_bytes": self.swap_in_bytes,
+        }

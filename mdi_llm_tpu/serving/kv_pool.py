@@ -41,7 +41,7 @@ heads keeps this file untouched by distribution.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["KVPool"]
 
@@ -66,6 +66,20 @@ class KVPool:
         self._evictable: "OrderedDict[int, None]" = OrderedDict()  # ref==0, cached
         self.prefix_hits = 0  # blocks reused copy-free
         self.prefix_queries = 0  # full blocks looked up
+        # host-RAM tier seam (serving/host_tier.py): the allocator stays
+        # device- AND tier-blind — the engine installs these when
+        # ServingConfig.host_pool_mib > 0, and every tier decision rides
+        # the two existing choke points (`_take` eviction, `match_prefix`
+        # miss).  None (the default) is today's behavior, bit-for-bit.
+        self.host = None  # Optional[host_tier.HostTier], for snapshot()
+        # (block, chain_hash) -> None, called as a cached block is evicted:
+        # the engine copies the block's bytes to a host slot (spill)
+        self.spill_hook: Optional[Callable[[int, int], None]] = None
+        # chain_hash -> fresh HBM block (refcount 1) with the spilled
+        # payload's restore scheduled, or None when the hash isn't spilled
+        # / no capacity.  Hits through this path count as prefix_hits_host.
+        self.restore_hook: Optional[Callable[[int], Optional[int]]] = None
+        self.prefix_hits_host = 0  # blocks restored from the host tier
 
     # -- capacity ------------------------------------------------------------
 
@@ -91,7 +105,7 @@ class KVPool:
         """Point-in-time allocator gauges for the observability layer
         (`obs/`): pure host-side counters the pool already maintains —
         reading them costs nothing and touches no device state."""
-        return {
+        snap = {
             "num_blocks": self.num_blocks,
             "used_blocks": self.used,
             "available_blocks": self.available,
@@ -100,6 +114,10 @@ class KVPool:
             "prefix_hits": self.prefix_hits,
             "prefix_queries": self.prefix_queries,
         }
+        if self.host is not None:
+            snap["prefix_hits_host"] = self.prefix_hits_host
+            snap.update(self.host.snapshot())
+        return snap
 
     # -- allocation ----------------------------------------------------------
 
@@ -110,6 +128,11 @@ class KVPool:
             blk, _ = self._evictable.popitem(last=False)
             h = self._block_hash.pop(blk)
             del self._hash_to_block[h]
+            if self.spill_hook is not None:
+                # host tier: copy the cold chain block down instead of
+                # dropping it (the gather snapshots the block's bytes
+                # before the new owner's first write can land)
+                self.spill_hook(blk, h)
             return blk
         return None
 
@@ -172,7 +195,18 @@ class KVPool:
             self.prefix_queries += 1
             blk = self._hash_to_block.get(h)
             if blk is None:
-                break
+                # host tier: a chain that fell out of HBM may live on in
+                # the spilled store — the hook hands back a fresh block
+                # (refcount already 1) with the payload restore scheduled
+                if self.restore_hook is not None:
+                    blk = self.restore_hook(h)
+                if blk is None:
+                    break
+                self.prefix_hits_host += 1
+                self._hash_to_block[h] = blk
+                self._block_hash[blk] = h
+                matched.append(blk)
+                continue
             self.prefix_hits += 1
             self._ref[blk] = self._ref.get(blk, 0) + 1
             self._evictable.pop(blk, None)

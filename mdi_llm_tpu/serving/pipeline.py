@@ -172,6 +172,9 @@ class PipelinedServingEngine(ServingEngine):
     are the base engine's — only the device execution backend changes.
     """
 
+    # stage-stacked pool leaves are (S, l_max, NB, ...) — blocks on axis 2
+    _kv_block_axis = 2
+
     def __init__(self, gen, serving: ServingConfig, obs=None, policy=None):
         mesh = gen.mesh
         if mesh is None or int(dict(mesh.shape).get("pp", 1)) <= 1:

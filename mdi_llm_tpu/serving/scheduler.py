@@ -33,7 +33,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from mdi_llm_tpu.serving.kv_pool import KVPool
 from mdi_llm_tpu.serving.policy import FCFSPolicy, SchedulingPolicy
@@ -123,6 +123,25 @@ class Scheduler:
         self.finished: List[SequenceState] = []
         self._admit_counter = 0  # admission recency for preemption order
         self.preemptions = 0
+        # host-RAM tier seam (serving/host_tier.py): the scheduler stays
+        # device- and tier-blind — when ServingConfig.host_pool_mib > 0
+        # the engine installs these hooks, and swap-vs-recompute becomes a
+        # per-victim cost-model decision instead of always-recompute.
+        # rid -> SwapRecord for queued preempted entries whose KV lives in
+        # host slots (the deque keeps its historical (req, toks) tuples so
+        # the open-system frontend's cancellation scan is untouched).
+        self.swap_records: Dict[str, object] = {}
+        # seq -> Optional[SwapRecord]: engine gathers the victim's blocks
+        # to host slots (enqueued BEFORE the release below frees them) and
+        # returns the record, or None to fall back to recompute
+        self.swap_out_hook: Optional[Callable[[SequenceState], Optional[object]]] = None
+        # (record, hbm_blocks) -> None: engine schedules the payload
+        # restore into freshly allocated blocks and reclaims the host slots
+        self.swap_in_hook: Optional[Callable[[object, List[int]], None]] = None
+        # record -> None: release host slots without restoring (cancel path)
+        self.swap_drop_hook: Optional[Callable[[object], None]] = None
+        self.swaps_out = 0  # preemptions resolved by swap, not recompute
+        self.swaps_in = 0  # admissions resumed from host-tier payloads
         # observability hook (obs.ServingObserver or None): the scheduler
         # owns the request lifecycle edges — submitted/admitted/resumed/
         # preempted/retired — so it reports them; all hooks are plain
@@ -191,6 +210,8 @@ class Scheduler:
         if slot is None:
             return None
         tokens = resume_tokens or req.prompt
+        if resume_tokens and req.rid in self.swap_records:
+            return self._try_admit_swapped(req, resume_tokens, slot)
         cached, n_cached = self.pool.match_prefix(tokens)
         # cover every prefill write plus the first decode write
         target = len(tokens) - 1 if resume_tokens else len(tokens)
@@ -210,6 +231,47 @@ class Scheduler:
                 resumed=resume_tokens is not None,
             )
         return seq
+
+    def _try_admit_swapped(self, req: Request, resume_tokens: List[int],
+                           slot: int) -> Optional[SequenceState]:
+        """Resume a swapped-out victim: allocate its whole table fresh
+        (the restored payload carries the KV, so the prefix cache is
+        bypassed — sharing a matched block would alias restore writes into
+        it), schedule the host→HBM restore, and admit the sequence
+        fully-cached: `fed` lands on the swap record's token coverage, so
+        a mid-decode victim re-enters with ZERO re-prefill (its pending
+        token is set immediately) and a mid-prefill victim re-prefills
+        only the tail it had not fed yet."""
+        record = self.swap_records[req.rid]
+        target = len(resume_tokens) - 1  # the pending token rides along
+        owned = self.pool.alloc(self.pool.blocks_needed(target + 1))
+        if owned is None:
+            return None  # record kept; the next admit() retries
+        del self.swap_records[req.rid]
+        n_cached = min(record.n_tokens, target)
+        self.swap_in_hook(
+            record, owned[: self.pool.blocks_needed(record.n_tokens)]
+        )
+        self.swaps_in += 1
+        seq = SequenceState(req, owned, n_cached, slot,
+                            resume_tokens=resume_tokens)
+        seq.admit_order = self._admit_counter
+        self._admit_counter += 1
+        self.slots[slot] = seq
+        if self.observer is not None:
+            self.observer.request_admitted(
+                req.rid, slot, seq.admit_order, n_cached=n_cached,
+                resumed=True, restored=True,
+            )
+        return seq
+
+    def drop_swap_record(self, rid: str) -> None:  # mdi-thread: engine
+        """Forget a queued entry's swap record, releasing its host slots
+        (the open-system frontend's cancel path, after it removes the
+        entry from `preempted`).  No-op when the rid holds no record."""
+        record = self.swap_records.pop(rid, None)
+        if record is not None and self.swap_drop_hook is not None:
+            self.swap_drop_hook(record)
 
     def admit(self) -> List[SequenceState]:  # mdi-thread: engine
         """Policy-ordered admission, preempted sequences first (they hold
@@ -268,6 +330,15 @@ class Scheduler:
         if not victims:
             return False
         seq = min(victims, key=lambda s: (s.req.priority, -s.admit_order))
+        # host tier: offer the victim to the engine's swap path BEFORE the
+        # release below recycles its blocks — the gather snapshotting the
+        # payload is enqueued while the blocks are still owned, so device
+        # in-order execution reads them ahead of any new owner's writes.
+        # None (cost model says recompute, tier full, or no tier) keeps
+        # the historical recompute behavior bit-for-bit.
+        record = None
+        if self.swap_out_hook is not None:
+            record = self.swap_out_hook(seq)
         self.slots[seq.slot] = None
         self.pool.release(seq.blocks)
         seq.blocks = []
@@ -275,10 +346,15 @@ class Scheduler:
         toks = list(seq.tokens)
         if seq.next_tok is not None and (not toks or toks[-1] != seq.next_tok):
             toks.append(seq.next_tok)
+        if record is not None:
+            self.swap_records[seq.req.rid] = record
+            self.swaps_out += 1
         self.preempted.appendleft((seq.req, toks))
         self.preemptions += 1
         if self.observer is not None:
-            self.observer.request_preempted(seq.req.rid, seq.n_generated)
+            self.observer.request_preempted(
+                seq.req.rid, seq.n_generated, swapped=record is not None
+            )
         return True
 
     def ensure_blocks_for(self, seq: SequenceState, n_writes: int = 1) -> bool:  # mdi-thread: engine

@@ -1,0 +1,647 @@
+"""Host-RAM KV tier tests (serving/host_tier.py + the engine/scheduler/
+analysis wiring behind `ServingConfig.host_pool_mib`).
+
+Four layers under test:
+
+1. the pure host-side pieces — `HostBlockStore` slab round-trips are
+   bit-exact (fp and int8 payload+scale layouts), allocation is
+   all-or-nothing, `SwapCostModel` decisions are deterministic under a
+   fake clock/BW and EWMA-correct toward measurements, and `HostTier`
+   capacity lets swaps evict spilled prefix blocks but never the
+   reverse;
+2. the engine device paths — swap-out gather / restore scatter
+   round-trip a victim's blocks byte-identically (fp32, int8, tp=2),
+   a preemption-heavy trace resolved by SWAP stays greedy
+   token-identical to sequential `generate` (the same contract the
+   recompute path ships under), a spilled prefix chain restores from
+   host and counts `prefix_hits_host`, and the steady state stays
+   clean under `jax.transfer_guard("disallow")` with zero post-warmup
+   recompiles;
+3. the scheduler seam — swap records ride preempted entries, a
+   swapped resume re-enters with ZERO re-prefill, and the cancel path
+   releases host slots through `drop_swap_record`;
+4. the analysis/CLI surface — mdi-audit's `bad-host-tier` fixture
+   pairs, the byte-exact `host_pool_bytes` contract against the live
+   slabs, mdi-flow's hbm-over-budget host credit (both directions),
+   and `--host-pool-mib` on every entry point's --help.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdi_llm_tpu.analysis.audit import preflight
+from mdi_llm_tpu.analysis.ir import trace_serving
+from mdi_llm_tpu.analysis.liveness import flow_preflight
+from mdi_llm_tpu.config import Config, ServingConfig
+from mdi_llm_tpu.generation import Generator
+from mdi_llm_tpu.models import init_params
+from mdi_llm_tpu.parallel.mesh import make_mesh
+from mdi_llm_tpu.serving.host_tier import (
+    DEFAULT_HOST_LINK_GBPS,
+    HOST_LINK_GBPS,
+    HostBlockStore,
+    HostTier,
+    SwapCostModel,
+    SwapRecord,
+    lookup_host_link_gbps,
+)
+from mdi_llm_tpu.serving.kv_pool import KVPool
+from mdi_llm_tpu.serving.scheduler import Request, Scheduler
+from mdi_llm_tpu.utils.profiling import CompileGuard
+from tests.test_model import tiny_config
+from tests.test_serving import _sequential_greedy
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = tiny_config(block_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# HostBlockStore
+# ---------------------------------------------------------------------------
+
+
+def _fill(rng, shape, dtype):
+    dt = np.dtype(dtype)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return rng.integers(info.min, info.max, size=shape, dtype=dt,
+                            endpoint=True)
+    return rng.standard_normal(shape).astype(dt)
+
+
+def test_store_roundtrip_is_bit_exact_fp():
+    # two leaves mirroring the flat pool's k/v with blocks on axis 1
+    shapes = [((2, 6, 4, 3, 5), np.float32), ((2, 6, 4, 3, 5), np.float16)]
+    store = HostBlockStore(shapes, block_axis=1, num_slots=4)
+    rng = np.random.default_rng(0)
+    slots = store.alloc(3)
+    assert slots is not None and len(slots) == 3
+    # write takes block-axis-LEADING payloads: row k is block k
+    payload = [_fill(rng, (3, 2, 4, 3, 5), d) for _, d in shapes]
+    store.write(slots, payload)
+    back = store.read(slots)
+    for want, got, (_, d) in zip(payload, back, shapes):
+        assert got.dtype == np.dtype(d)
+        assert np.array_equal(
+            want.view(np.uint8), got.view(np.uint8)
+        ), "host slab round-trip must be bit-exact"
+    # reads are copies: mutating the result must not touch the slabs
+    back[0][...] = 0
+    again = store.read(slots)
+    assert np.array_equal(payload[0], again[0])
+
+
+def test_store_roundtrip_is_bit_exact_int8_payload_and_scale():
+    # int8 pool layout: quantized payload + f32 scales (no block-size axis)
+    shapes = [((2, 5, 4, 3), np.int8), ((2, 5, 3), np.float32)]
+    store = HostBlockStore(shapes, block_axis=1, num_slots=5)
+    rng = np.random.default_rng(1)
+    slots = store.alloc(2)
+    payload = [_fill(rng, (2, 2, 4, 3), np.int8),
+               _fill(rng, (2, 2, 3), np.float32)]
+    store.write(slots, payload)
+    for want, got in zip(payload, store.read(slots)):
+        assert np.array_equal(want, got) and want.dtype == got.dtype
+
+
+def test_store_write_drops_transfer_padding_rows():
+    shapes = [((1, 4, 2), np.float32)]
+    store = HostBlockStore(shapes, block_axis=1, num_slots=4)
+    rng = np.random.default_rng(2)
+    slots = store.alloc(2)
+    # fixed-width transfer quantum: rows past len(slots) are padding
+    padded = _fill(rng, (4, 1, 2), np.float32)
+    store.write(slots, [padded])
+    assert np.array_equal(store.read(slots)[0], padded[:2])
+
+
+def test_store_alloc_all_or_nothing_and_recycles():
+    store = HostBlockStore([((1, 3, 2), np.float32)], 1, num_slots=3)
+    assert store.available == 3 and store.nbytes == 3 * 2 * 4
+    a = store.alloc(2)
+    assert a is not None and store.used == 2
+    assert store.alloc(2) is None, "partial grabs must not happen"
+    assert store.used == 2  # the failed alloc changed nothing
+    b = store.alloc(1)
+    assert b is not None and store.available == 0
+    store.release(a)
+    c = store.alloc(2)
+    assert c is not None and set(c) == set(a), "slots actually recycled"
+
+
+def test_store_nbytes_is_slots_times_block_bytes(served_model):
+    """The byte contract mdi-audit pins: a live engine's slabs hold
+    exactly `num_host_blocks x block_bytes(tp=1)` bytes."""
+    cfg, params = served_model
+    sv = ServingConfig(block_size=4, host_pool_mib=4)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, host_pool_mib=4
+    )
+    per_block = sv.block_bytes(cfg, "float32", tp=1)["total_bytes"]
+    assert engine.host_tier.store.num_slots == sv.num_host_blocks(
+        cfg, "float32"
+    )
+    assert engine.host_tier.store.nbytes == engine.host_tier.store.num_slots * per_block
+    assert engine.host_tier.store.nbytes == sv.host_pool_bytes(cfg, "float32")
+
+
+# ---------------------------------------------------------------------------
+# SwapCostModel
+# ---------------------------------------------------------------------------
+
+
+def test_cost_model_swap_vs_recompute_boundary():
+    cm = SwapCostModel(link_gbps=8.0, prefill_tokens_per_s=2000.0)
+    assert cm.swap_seconds(8_000_000_000) == pytest.approx(1.0)
+    assert cm.recompute_seconds(2000) == pytest.approx(1.0)
+    # round trip (2x swap) vs re-prefill: 2.0s vs recompute_seconds
+    nbytes = 8_000_000_000  # 1.0s one way -> 2.0s round trip
+    assert cm.should_swap(nbytes, 4001) is True  # 2.0005s recompute
+    assert cm.should_swap(nbytes, 4000) is False  # exactly equal: no win
+    assert cm.should_swap(nbytes, 3999) is False
+
+
+def test_cost_model_zero_bandwidth_never_swaps():
+    cm = SwapCostModel(link_gbps=0.0)
+    assert cm.swap_seconds(1) == float("inf")
+    assert cm.should_swap(1, 10**9) is False
+
+
+def test_cost_model_ewma_tracks_measurements():
+    cm = SwapCostModel(link_gbps=8.0, prefill_tokens_per_s=2000.0, ewma=0.25)
+    cm.observe_transfer(16_000_000_000, 1.0)  # measured 16 GB/s
+    assert cm.link_gbps == pytest.approx(8.0 + 0.25 * (16.0 - 8.0))
+    cm.observe_prefill(4000, 1.0)  # measured 4000 tok/s
+    assert cm.prefill_tokens_per_s == pytest.approx(2000 + 0.25 * 2000)
+    before = (cm.link_gbps, cm.prefill_tokens_per_s)
+    cm.observe_transfer(0, 1.0)  # degenerate measurements are ignored
+    cm.observe_prefill(100, 0.0)
+    assert (cm.link_gbps, cm.prefill_tokens_per_s) == before
+
+
+def test_link_bandwidth_table_lookup():
+    assert lookup_host_link_gbps("TPU v4") == HOST_LINK_GBPS["TPU v4"]
+    # longest prefix wins: "TPU v5 lite" over "TPU v5"
+    assert lookup_host_link_gbps("TPU v5 lite") == HOST_LINK_GBPS["TPU v5 lite"]
+    assert lookup_host_link_gbps("TPU v5p") == HOST_LINK_GBPS["TPU v5p"]
+    assert lookup_host_link_gbps("cpu") == DEFAULT_HOST_LINK_GBPS
+    assert lookup_host_link_gbps(None) == DEFAULT_HOST_LINK_GBPS
+    sv = ServingConfig(host_link_gbps=3.5)
+    assert sv.resolved_host_link_gbps("TPU v4") == 3.5  # explicit wins
+
+
+# ---------------------------------------------------------------------------
+# HostTier capacity: state (swaps) beats cache (spills)
+# ---------------------------------------------------------------------------
+
+
+def _tier(num_slots):
+    store = HostBlockStore([((1, 3, 2), np.float32)], 1, num_slots)
+    return HostTier(store, SwapCostModel(link_gbps=8.0), prefix_spill=True)
+
+
+def test_swap_alloc_evicts_spilled_blocks_lru():
+    tier = _tier(3)
+    for h in (101, 102, 103):
+        slot = tier.alloc_for_spill()
+        tier.record_spill(h, slot)
+    assert len(tier.spilled) == 3 and tier.store.available == 0
+    got = tier.alloc_for_swap(2)
+    assert got is not None and len(got) == 2
+    # oldest spills evicted first; the newest survives
+    assert list(tier.spilled) == [103]
+
+
+def test_spill_alloc_never_displaces_swap_slots():
+    tier = _tier(2)
+    swap = tier.alloc_for_swap(2)
+    assert swap is not None and tier.store.available == 0
+    # nothing spilled to recycle and no free slot: the spill is refused
+    assert tier.alloc_for_spill() is None
+    assert tier.store.used == 2  # the swap slots are untouched
+    # with one spilled block present, spills recycle ONLY among spills
+    tier.store.release([swap.pop()])
+    s = tier.alloc_for_spill()
+    tier.record_spill(7, s)
+    s2 = tier.alloc_for_spill()
+    assert s2 == s and tier.take_spill(7) is None  # recycled the spill
+
+
+def test_tier_snapshot_keys():
+    tier = _tier(2)
+    snap = tier.snapshot()
+    assert snap["host_blocks"] == 2 and snap["host_pool_bytes"] == tier.store.nbytes
+    for k in ("host_used_blocks", "host_spilled_blocks", "swaps_out",
+              "swaps_in", "swap_out_bytes", "swap_in_bytes"):
+        assert snap[k] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: swap-out gather / restore scatter round-trip (byte parity)
+# ---------------------------------------------------------------------------
+
+
+def _block_payload(engine, blocks):
+    """Per-leaf host copies of `blocks`, block axis leading (the store's
+    layout) — the reference the swap round-trip must reproduce."""
+    ba = engine._kv_block_axis
+    out = []
+    for leaf in jax.tree_util.tree_leaves(engine._kv):
+        arr = np.asarray(leaf)  # mdi-lint: disable=host-sync -- test readback
+        out.append(np.moveaxis(np.take(arr, blocks, axis=ba), ba, 0))
+    return out
+
+
+def _drive_until_decoding(engine, min_fed):
+    for _ in range(200):
+        running = engine.scheduler.running()
+        if running and running[0].fed >= min_fed:
+            return running[0]
+        assert engine.step(), "engine went idle before the target fed"
+    raise AssertionError("never reached the target fed position")
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_swap_roundtrip_byte_parity(served_model, kv_dtype):
+    """Gather a victim's blocks to host slots, restore them into FRESH
+    blocks: the restored device bytes equal the originals exactly (fp32
+    and the int8 payload+scale layout)."""
+    cfg, params = served_model
+    rng = np.random.default_rng(3)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=1, max_blocks=1 + 30, prefix_caching=False,
+        host_pool_mib=64, host_link_gbps=1000.0, kv_dtype=kv_dtype,
+    )
+    engine.add_request("r", rng.integers(1, cfg.vocab_size, 13).tolist(), 8)
+    seq = _drive_until_decoding(engine, min_fed=9)
+    n_blocks = engine.pool.blocks_needed(seq.fed)
+    victim_blocks = list(seq.blocks[:n_blocks])
+    want = _block_payload(engine, victim_blocks)
+
+    record = engine._swap_out(seq)
+    assert record is not None and len(record.slots) == n_blocks
+    engine._drain_swaps()
+    for w, h in zip(want, engine.host_tier.store.read(record.slots)):
+        assert w.dtype == h.dtype
+        assert np.array_equal(w.view(np.uint8), h.view(np.uint8))
+
+    fresh = engine.pool.alloc(n_blocks)
+    assert fresh is not None and set(fresh).isdisjoint(victim_blocks)
+    engine._swap_in(record, fresh)
+    for w, g in zip(want, _block_payload(engine, fresh)):
+        assert np.array_equal(w.view(np.uint8), g.view(np.uint8)), (
+            "host->HBM restore must be byte-identical"
+        )
+    assert engine.host_tier.swaps_in == 1
+    assert engine.host_tier.store.used == 0  # slots released after restore
+
+
+def test_swap_roundtrip_byte_parity_tp2(served_model):
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg, params = served_model
+    rng = np.random.default_rng(4)
+    engine = Generator(
+        cfg, params, cache_dtype=jnp.float32,
+        mesh=make_mesh({"tp": 2}, devices[:2]),
+    ).serve(block_size=4, max_batch=1, max_blocks=1 + 30,
+            prefix_caching=False, host_pool_mib=64, host_link_gbps=1000.0)
+    engine.add_request("r", rng.integers(1, cfg.vocab_size, 13).tolist(), 8)
+    seq = _drive_until_decoding(engine, min_fed=9)
+    n_blocks = engine.pool.blocks_needed(seq.fed)
+    want = _block_payload(engine, list(seq.blocks[:n_blocks]))
+    record = engine._swap_out(seq)
+    assert record is not None
+    engine._drain_swaps()
+    fresh = engine.pool.alloc(n_blocks)
+    engine._swap_in(record, fresh)
+    for w, g in zip(want, _block_payload(engine, fresh)):
+        # the store keeps GLOBAL (unsharded) blocks: tp round-trips whole
+        assert np.array_equal(w.view(np.uint8), g.view(np.uint8))
+
+
+# ---------------------------------------------------------------------------
+# engine: swap preemption keeps the greedy parity contract
+# ---------------------------------------------------------------------------
+
+_PREEMPT_KNOBS = dict(block_size=4, max_batch=3, max_blocks=1 + 14,
+                      prefix_caching=False, decode_chunk=1)
+
+
+def _preempt_prompts(cfg):
+    rng = np.random.default_rng(9)
+    return [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+            for n in (9, 13, 11)]
+
+
+def test_swap_preemption_matches_sequential_generate(served_model):
+    """The acceptance contract, swap edition: the same pool-starved trace
+    that forces recompute preemption, resolved by SWAP instead — outputs
+    stay token-identical to solo `generate()` runs, with zero re-prefill
+    hiding behind the parity (a wrong restored byte WOULD diverge)."""
+    cfg, params = served_model
+    prompts = _preempt_prompts(cfg)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        host_pool_mib=64, host_link_gbps=1000.0, **_PREEMPT_KNOBS
+    )
+    for i, p in enumerate(prompts):
+        engine.add_request(f"p{i}", p, 10)
+    results, stats = engine.run()
+    assert stats.preemptions >= 1, "pool was sized to force preemption"
+    assert stats.swaps_out >= 1, "the 1000 GB/s link must choose swap"
+    assert stats.swaps_in == stats.swaps_out
+    assert stats.swap_out_bytes > 0 and stats.swap_in_bytes > 0
+    want = _sequential_greedy(cfg, params, prompts, [10, 10, 10])
+    for i in range(len(prompts)):
+        assert results[f"p{i}"] == want[i], f"p{i} diverged across its swap"
+
+
+def test_int8_swap_matches_int8_recompute(served_model):
+    """int8 quantization shifts tokens vs fp, so the int8 swap engine is
+    held to its int8 recompute twin: byte-identical restores mean the
+    two resolutions of the same preemption cannot differ."""
+    cfg, params = served_model
+    prompts = _preempt_prompts(cfg)
+
+    def run(host_mib):
+        engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+            kv_dtype="int8", host_pool_mib=host_mib,
+            host_link_gbps=1000.0, **_PREEMPT_KNOBS
+        )
+        for i, p in enumerate(prompts):
+            engine.add_request(f"p{i}", p, 10)
+        return engine.run()
+
+    recompute, rstats = run(0)
+    swapped, sstats = run(64)
+    assert rstats.preemptions >= 1 and rstats.swaps_out == 0
+    assert sstats.swaps_out >= 1
+    assert swapped == recompute
+
+
+# ---------------------------------------------------------------------------
+# engine: spillable prefix cache
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_spill_restores_evicted_chain(served_model):
+    """Serial A/B/C trace: A registers a prefix chain, B's footprint
+    evicts it (spilling to host), C re-uses the prefix — the hit restores
+    from host (`prefix_hits_host`) and C's tokens match the no-tier run
+    (which recomputes the evicted prefix from scratch)."""
+    cfg, params = served_model
+    rng = np.random.default_rng(6)
+    shared = rng.integers(1, cfg.vocab_size, 16).tolist()
+    reqs = [
+        ("a", shared + rng.integers(1, cfg.vocab_size, 4).tolist(), 4),
+        ("b", rng.integers(1, cfg.vocab_size, 24).tolist(), 4),
+        ("c", shared + rng.integers(1, cfg.vocab_size, 4).tolist(), 4),
+    ]
+
+    def run(host_mib):
+        engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+            block_size=4, max_batch=1, max_blocks=1 + 7,
+            prefix_caching=True, host_pool_mib=host_mib,
+            host_link_gbps=1000.0,
+        )
+        for rid, p, m in reqs:
+            engine.add_request(rid, p, m)
+        return engine.run()
+
+    plain, _ = run(0)
+    tiered, stats = run(64)
+    assert stats.prefix_hits_host >= 1, "the evicted chain must hit on host"
+    assert tiered == plain, "a host-restored prefix changed the tokens"
+
+
+# ---------------------------------------------------------------------------
+# engine: steady-state compile/transfer contract
+# ---------------------------------------------------------------------------
+
+
+def test_tier_steady_state_is_recompile_and_transfer_clean(served_model):
+    """A warmed tiered engine keeps serving — with live swaps — under
+    `jax.transfer_guard("disallow")` and with ZERO post-warmup retraces:
+    every tier transfer is an explicit host-boundary op and the
+    fixed-width fetch/restore executables cover any victim size."""
+    cfg, params = served_model
+    rng = np.random.default_rng(9)
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    engine = gen.serve(host_pool_mib=64, host_link_gbps=1000.0,
+                       **_PREEMPT_KNOBS)
+    mk = lambda n: rng.integers(1, cfg.vocab_size, int(n)).tolist()
+    g = CompileGuard(label="tier")
+    with g:
+        for i, n in enumerate((9, 13, 11)):
+            engine.add_request(f"w{i}", mk(n), 10)
+        engine.run()  # warmup traces every reachable executable
+        warm_swaps = engine.scheduler.swaps_out
+        assert warm_swaps >= 1, "warmup trace must exercise the swap path"
+        g.mark_warm()
+        for i, n in enumerate((9, 13, 11)):
+            engine.add_request(f"t{i}", mk(n), 10)
+        with jax.transfer_guard("disallow"):
+            while engine.step():
+                pass
+    assert engine.scheduler.swaps_out > warm_swaps, (
+        "steady state must have swapped under the guard"
+    )
+    assert g.traces_after_warmup == 0
+    g.expect_clean()
+
+
+# ---------------------------------------------------------------------------
+# scheduler seam: swap records, zero re-prefill resume, cancel drop
+# ---------------------------------------------------------------------------
+
+
+def _scheduler(num_blocks=17, block_size=4, max_batch=1):
+    pool = KVPool(num_blocks=num_blocks, block_size=block_size)
+    return Scheduler(pool, max_batch=max_batch, prefill_chunk=8,
+                     max_seq_length=128), pool
+
+
+def test_scheduler_swapped_resume_has_zero_reprefill():
+    sched, pool = _scheduler()
+    calls = {}
+    record = SwapRecord(slots=[5, 6, 7], n_tokens=11, nbytes=99)
+    sched.swap_out_hook = lambda seq: record
+    sched.swap_in_hook = lambda rec, blocks: calls.update(
+        rec=rec, blocks=list(blocks)
+    )
+    sched.add(Request("r0", list(range(1, 12)), 8))  # 11-token prompt
+    sched.admit()
+    seq = sched.running()[0]
+    seq.fed = 11  # fully prefilled, mid-decode
+    seq.next_tok = 77  # sampled, pending
+    assert sched.preempt_latest()
+    assert sched.swaps_out == 1 and "r0" in sched.swap_records
+    assert sched.running() == [] and pool.used == 0
+
+    resumed = sched.admit()
+    assert len(resumed) == 1
+    seq2 = resumed[0]
+    # the restore covered every fed token: NO re-prefill, the pending
+    # token is restored immediately and the lane is decode-ready
+    assert calls["rec"] is record
+    assert len(calls["blocks"]) == pool.blocks_needed(record.n_tokens)
+    assert seq2.n_cached == record.n_tokens and seq2.fed == record.n_tokens
+    assert not seq2.needs_prefill
+    assert seq2.next_tok == 77
+    assert sched.swaps_in == 1 and "r0" not in sched.swap_records
+
+
+def test_scheduler_recompute_fallback_when_hook_declines():
+    sched, pool = _scheduler()
+    sched.swap_out_hook = lambda seq: None  # cost model said recompute
+    sched.add(Request("r0", [1, 2, 3, 4, 5], 4))
+    sched.admit()
+    sched.running()[0].fed = 5
+    assert sched.preempt_latest()
+    assert sched.swaps_out == 0 and sched.swap_records == {}
+    seq = sched.admit()[0]
+    assert seq.needs_prefill, "recompute resumes re-prefill their tokens"
+
+
+def test_scheduler_drop_swap_record_releases_host_slots():
+    sched, _ = _scheduler()
+    dropped = []
+    record = SwapRecord(slots=[3, 4], n_tokens=8, nbytes=10)
+    sched.swap_out_hook = lambda seq: record
+    sched.swap_drop_hook = dropped.append
+    sched.add(Request("r0", list(range(1, 10)), 4))
+    sched.admit()
+    sched.running()[0].fed = 9
+    sched.preempt_latest()
+    # the frontend's cancel path: remove from the queue, then drop
+    sched.preempted.clear()
+    sched.drop_swap_record("r0")
+    assert dropped == [record] and sched.swap_records == {}
+    sched.drop_swap_record("never-swapped")  # unknown rid: no-op
+    assert dropped == [record]
+
+
+# ---------------------------------------------------------------------------
+# mdi-audit: bad-host-tier fixture pairs + the byte-exact breakdown
+# ---------------------------------------------------------------------------
+
+
+def _codes(report):
+    return [f.rule for f in report.findings]
+
+
+def test_audit_flags_host_tier_over_budget():
+    r = preflight(Config.from_name("pythia-14m"),
+                  serving=ServingConfig(host_pool_mib=2048),
+                  host_gb=0.25)
+    assert _codes(r).count("bad-host-tier") == 1
+
+
+def test_audit_flags_spill_without_prefix_caching():
+    r = preflight(Config.from_name("pythia-14m"),
+                  serving=ServingConfig(host_pool_mib=64,
+                                        prefix_caching=False,
+                                        host_prefix_spill=True))
+    assert _codes(r).count("bad-host-tier") == 1
+
+
+def test_audit_flags_zero_bandwidth_link():
+    r = preflight(Config.from_name("pythia-14m"),
+                  serving=ServingConfig(host_pool_mib=64,
+                                        host_link_gbps=0.0))
+    assert _codes(r).count("bad-host-tier") == 1
+
+
+def test_audit_good_tier_plan_is_clean():
+    r = preflight(Config.from_name("pythia-14m"),
+                  serving=ServingConfig(host_pool_mib=64), host_gb=1.0)
+    assert "bad-host-tier" not in _codes(r)
+    # tier off: the checker (and the breakdown bytes) stay zero
+    r0 = preflight(Config.from_name("pythia-14m"),
+                   serving=ServingConfig(), host_gb=0.0)
+    assert "bad-host-tier" not in _codes(r0)
+    assert r0.breakdown["kv_pool"]["host_pool_bytes"] == 0
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_audit_host_pool_bytes_matches_live_slabs(served_model, kv_dtype):
+    """`kv_pool.host_pool_bytes` in the audit breakdown equals the LIVE
+    `HostBlockStore.nbytes` exactly — the static estimate and the pinned
+    allocation can never drift (fp32 and int8 payload+scale layouts)."""
+    cfg, params = served_model
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, host_pool_mib=4, kv_dtype=kv_dtype
+    )
+    r = preflight(
+        cfg, cache_dtype="float32",
+        serving=ServingConfig(block_size=4, host_pool_mib=4,
+                              kv_dtype=kv_dtype),
+    )
+    pool = r.breakdown["kv_pool"]
+    assert pool["host_pool_bytes"] == engine.host_tier.store.nbytes
+    assert pool["host_blocks"] == engine.host_tier.store.num_slots
+
+
+# ---------------------------------------------------------------------------
+# mdi-flow: the hbm-over-budget host credit, both directions
+# ---------------------------------------------------------------------------
+
+
+def test_flow_budget_credits_swapped_blocks_both_directions():
+    """A budget chosen between the tiered and untiered high-waters: the
+    untiered engine trips hbm-over-budget, a sufficient tier passes, and
+    a too-small tier still trips — the credit is sized, not a waiver."""
+    cfg = Config.from_name("pythia-14m")
+
+    def report(host_mib, hbm_gb):
+        eng = trace_serving(cfg, ServingConfig(host_pool_mib=host_mib),
+                            max_seq_length=256)
+        return flow_preflight(eng, origin="t", hbm_gb=hbm_gb)
+
+    d0 = report(0, 64.0).breakdown["per_device"]
+    dT = report(64, 64.0).breakdown["per_device"]
+    dS = report(1, 64.0).breakdown["per_device"]
+    assert d0["host_credit_bytes"] == 0 and dT["host_credit_bytes"] > 0
+    assert dT["high_water_bytes"] == (
+        d0["high_water_bytes"] - dT["host_credit_bytes"]
+    )
+    assert dT["high_water_bytes"] < dS["high_water_bytes"] < d0["high_water_bytes"]
+
+    mid_gb = (dS["high_water_bytes"] + dT["high_water_bytes"]) / 2 / 2**30
+    assert "hbm-over-budget" in [f.rule for f in report(0, mid_gb).findings]
+    assert "hbm-over-budget" in [f.rule for f in report(1, mid_gb).findings]
+    assert "hbm-over-budget" not in [
+        f.rule for f in report(64, mid_gb).findings
+    ]
+
+
+# ---------------------------------------------------------------------------
+# CLI surface: the tier knobs exist on every entry point
+# ---------------------------------------------------------------------------
+
+
+def test_cli_help_covers_host_tier_flags():
+    from bench import build_parser as bench_parser
+    from mdi_llm_tpu.analysis.audit import build_parser as audit_parser
+    from mdi_llm_tpu.analysis.check import build_parser as check_parser
+    from mdi_llm_tpu.cli.serve import build_parser as serve_parser
+    from mdi_llm_tpu.cli.server import build_parser as server_parser
+
+    for parser in (serve_parser(), server_parser()):
+        help_text = parser.format_help()
+        assert "--host-pool-mib" in help_text
+        assert "--host-link-gbps" in help_text
+    for parser in (audit_parser(), check_parser()):
+        help_text = parser.format_help()
+        assert "--host-pool-mib" in help_text
+        assert "--host-gb" in help_text
+    bench_help = bench_parser().format_help()
+    assert "--serve-host-pool-mib" in bench_help
+    assert "--host-link-gbps" in bench_help
