@@ -204,8 +204,27 @@ def build_parser():
                     "chunk tokens per mixed dispatch; default "
                     "max_batch + prefill_chunk)")
     ap.add_argument("--spec-k", type=int, default=0,
-                    help="serve mode: n-gram speculative draft length "
-                    "(greedy only; 0 disables)")
+                    help="serve mode: speculative draft length (0 "
+                    "disables).  Exact-match verify at temperature 0 "
+                    "(token-identical to plain decode); rejection-sampled "
+                    "verify at temperature>0 (distribution-preserving)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="serve mode: sampling temperature (0 = greedy; "
+                    ">0 makes decode/verify draw from the filtered "
+                    "distribution)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="serve mode: top-k sampling filter "
+                    "(ServingConfig.top_k)")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="serve mode: nucleus sampling filter "
+                    "(ServingConfig.top_p)")
+    ap.add_argument("--draft-model", default=None, metavar="NAME",
+                    help="serve mode: registry name of a small draft "
+                    "model for speculative decode — drafts spec_k tokens "
+                    "per slot in one jitted scan from a second paged "
+                    "pool carved out of the block budget (random-init "
+                    "params: fine for throughput rows, useless accept "
+                    "rates on real text)")
     ap.add_argument("--serve-pool-mib", type=float, default=None,
                     help="serve mode: cap the KV pool at this many MiB — "
                     "max_blocks = budget // itemized bytes-per-block "
@@ -292,6 +311,10 @@ def _serve_config(args, cfg, kv_dtype=..., tier="on"):
         prefill_chunk=min(128, args.seq_len // 2),
         decode_chunk=args.serve_chunk,
         spec_k=args.spec_k,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        draft_model=args.draft_model,
         double_buffer=not args.no_double_buffer,
         token_budget=args.serve_token_budget,
         kv_dtype=kv_dtype,
@@ -727,6 +750,12 @@ def run_serve(args):
             rid, prompt, min(new, max(2, 2 * args.serve_chunk))
         )
     warm.run()
+    # the verify/draft executables fire only when a draft actually hits —
+    # a warmup trace with no echo leaves them cold and the first mid-serve
+    # hit would compile inside the timed region; prime() dispatches each
+    # once against the trash block (jit cache is per-Generator, so the
+    # timed engine below inherits the compiles)
+    warm.prime()
 
     # int8 rung: also run the FP engine on the SAME trace at the SAME pool
     # byte budget (its max_blocks shrink to what the bytes buy at fp width)
@@ -800,6 +829,42 @@ def run_serve(args):
             }
             if mode == "recompute":
                 tier_recompute_results = t_results
+
+    # sampled-spec rung: run the SAME trace through a per-step-sampling
+    # engine (spec_k=0, same temperature/top_k/top_p, same PRNG seed)
+    # before the warm mark, so detail.spec carries the head-to-head —
+    # tokens/s with the rejection-sampled verify amortizing host syncs
+    # over accepted drafts vs one sync per chunk, plus the accept rate
+    # those drafts actually achieved.  The baseline's compile set is a
+    # subset of the spec engine's (same mixed/decode shapes, no verify),
+    # so the timed region below still reports zero post-warmup recompiles
+    spec_baseline, spec_key0 = None, None
+    if args.spec_k > 0 and args.temperature != 0.0:
+        spec_key0 = gen.key
+        sv_base = _serve_config(args, cfg)
+        sv_base.spec_k = 0
+        sv_base.draft_model = None
+        b_warm = build_engine(obs=None, serving=sv_base)
+        for rid, prompt, new in trace:
+            b_warm.add_request(
+                rid, prompt, min(new, max(2, 2 * args.serve_chunk))
+            )
+        b_warm.run()
+        gen.key = spec_key0
+        b_engine = build_engine(obs=None, serving=sv_base)
+        for rid, prompt, new in trace:
+            b_engine.add_request(rid, prompt, new)
+        t0 = time.perf_counter()
+        _, b_stats = b_engine.run()
+        b_wall = time.perf_counter() - t0
+        spec_baseline = {
+            "tokens_per_s": round(
+                b_stats.tokens_generated / b_wall, 2
+            ) if b_wall else 0.0,
+            "host_syncs": b_stats.host_syncs,
+            "tokens_generated": b_stats.tokens_generated,
+        }
+        gen.key = spec_key0  # the timed spec run draws the same stream
 
     _mark_warm()
 
@@ -912,6 +977,8 @@ def run_serve(args):
             "block_size": args.serve_block_size,
             "token_budget": engine.token_budget,  # resolved, not the flag
             "decode_chunk": args.serve_chunk, "spec_k": args.spec_k,
+            "temperature": args.temperature, "top_k": args.top_k,
+            "top_p": args.top_p, "draft_model": args.draft_model,
             "double_buffer": not args.no_double_buffer,
             "scan_unroll": args.scan_unroll,
             "seq_len": args.seq_len, "new_tokens": args.new_tokens,
@@ -923,6 +990,26 @@ def run_serve(args):
         "kernel": engine.kernel_info(),
         "device": device_block,
     })
+    if spec_baseline is not None:
+        # sampled-spec head-to-head (serving-cb-spec): the timed engine's
+        # rejection-verify throughput and accept rate vs the per-step
+        # sampling baseline that ran the same trace at the same seed
+        drafted = stats.spec_drafted_ngram + stats.spec_drafted_model
+        accepted = stats.spec_accepted_ngram + stats.spec_accepted_model
+        detail["spec"] = {
+            "spec_k": args.spec_k,
+            "temperature": args.temperature,
+            "tokens_per_s": round(total, 2),
+            "drafted": drafted,
+            "accepted": accepted,
+            "accept_rate": round(accepted / drafted, 4) if drafted else 0.0,
+            "host_syncs": stats.host_syncs,
+            "baseline": spec_baseline,
+            "speedup": (
+                round(total / spec_baseline["tokens_per_s"], 3)
+                if spec_baseline["tokens_per_s"] else None
+            ),
+        }
     if args.pp > 1:
         # ring topology + fill model (serving/pipeline.py): stages, the
         # stage layer split, per-stage occupancy and the bubble fraction
@@ -1618,6 +1705,24 @@ SUITE_ROWS = [
         "ladder": [["--serve-pool-mib", "96"],
                    ["--serve-host-pool-mib", "0"]],
         "timeout": 1200,
+    },
+    {  # the SAMPLED-SPECULATIVE rung: the cb trace at temperature>0 with
+        # the rejection-sampled verify over n-gram drafts, head-to-head
+        # against the SAME trace through per-step sampling (spec_k=0) at
+        # the same PRNG seed — detail.spec banks both tokens/s, the
+        # accept rate the drafts achieved, and the host-sync counts the
+        # speedup comes from.  top_k=1 keeps the sampled stream
+        # deterministic so the n-gram drafter reliably fires on a
+        # random-init model (broader filters leave drafts workload-
+        # dependent: real weights echo, random ones may not); the ladder
+        # drops spec entirely so a verify-path failure still records a
+        # sampling serving row
+        "name": "serving-cb-spec",
+        "flags": ["--mode", "serve", "--batch", "8", "--seq-len", "512",
+                   "--new-tokens", "128", "--spec-k", "4",
+                   "--temperature", "0.7", "--top-k", "1"],
+        "ladder": [["--spec-k", "0", "--temperature", "0.7"]],
+        "timeout": 900,
     },
     {  # the OPEN-SYSTEM serving row (ROADMAP item 1's headline): Poisson
         # arrivals through the async front-end sweep offered load for the

@@ -964,11 +964,22 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
         problems.append(f"decode_chunk={sv.decode_chunk} must be >= 1")
     if sv.spec_k < 0:
         problems.append(f"spec_k={sv.spec_k} must be >= 0")
-    if sv.spec_k and sv.temperature != 0.0:
+    if sv.spec_k and sv.temperature != 0.0 and not sv.spec_verify_sampled():
+        # temperature>0 + spec_k is legal since the rejection-sampled
+        # verify; the wall now guards only the PINNED exact-match path
         problems.append(
-            f"spec_k={sv.spec_k} with temperature={sv.temperature:g}: "
-            "speculative serving is greedy-only (verify emits greedy "
-            "successors; ServingEngine refuses this config)"
+            f"spec_k={sv.spec_k} with temperature={sv.temperature:g} and "
+            "spec_sampled=False: the pinned exact-match verify emits "
+            "greedy successors and is only exact at temperature=0 — drop "
+            "spec_sampled=False (auto selects the rejection-sampled "
+            "verify at temperature>0) or set temperature=0 "
+            "(ServingEngine refuses this config)"
+        )
+    if sv.draft_model and not sv.spec_k:
+        problems.append(
+            f"draft_model={sv.draft_model!r} with spec_k=0: the draft "
+            "model has nothing to draft for — set spec_k > 0 "
+            "(ServingEngine refuses this config)"
         )
     n_blocks = sv.num_pool_blocks(plan.seq_len) if sv.block_size >= 1 else 0
     if sv.block_size >= 1 and n_blocks < 2:
@@ -986,12 +997,28 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
         # full-coverage pools (max_blocks=None) bound every slot at the
         # window, so only hand-sized pools can under-provision the K-step
         # reservation the chunked/speculative decode path holds per slot
-        problems.append(
-            f"max_blocks={sv.max_blocks}: {n_blocks - 1} usable block(s) "
-            f"cannot hold one slot's {headroom}-block chunk reservation "
-            f"headroom (decode_chunk={sv.decode_chunk}, spec_k={sv.spec_k}, "
-            f"double_buffer={sv.double_buffer}) plus its first write"
-        )
+        if sv.draft_model:
+            # n_blocks is already draft-aware (num_pool_blocks subtracts
+            # the carve-out), so name the knob that actually shrank it
+            n_draft = sv.num_draft_blocks(plan.seq_len)
+            problems.append(
+                f"draft_share={sv.draft_share:g} carves {n_draft} of "
+                f"max_blocks={sv.max_blocks} block(s) for the draft "
+                f"pool, leaving the target {n_blocks - 1} usable "
+                f"block(s) — below one slot's {headroom}-block "
+                f"chunk-reservation headroom (decode_chunk="
+                f"{sv.decode_chunk}, spec_k={sv.spec_k}, double_buffer="
+                f"{sv.double_buffer}) plus its first write; shrink "
+                "draft_share or grow max_blocks"
+            )
+        else:
+            problems.append(
+                f"max_blocks={sv.max_blocks}: {n_blocks - 1} usable "
+                f"block(s) cannot hold one slot's {headroom}-block chunk "
+                f"reservation headroom (decode_chunk={sv.decode_chunk}, "
+                f"spec_k={sv.spec_k}, double_buffer={sv.double_buffer}) "
+                "plus its first write"
+            )
     for p in problems:
         findings.append(_finding(plan, "bad-serving-config", p))
     # open-system server sizing (server/frontend.py): only when the plan
@@ -1091,6 +1118,40 @@ def _check_serving(plan: PlanSpec, findings: List[Finding], breakdown) -> None:
             "host_pool_bytes": sv.host_pool_bytes(plan.cfg, plan.kv_dtype),
             "host_blocks": sv.num_host_blocks(plan.cfg, plan.kv_dtype),
         }
+        if sv.draft_model:
+            # speculative draft model: its paged-pool carve-out, priced
+            # with the DRAFT architecture's block_bytes — byte-exact
+            # against the live engine's second KVPool
+            # (ServingEngine._init_draft_kv)
+            try:
+                dcfg = sv.draft_config()
+            except ValueError as e:
+                findings.append(_finding(
+                    plan, "bad-serving-config",
+                    f"draft_model={sv.draft_model!r}: {e}",
+                ))
+                dcfg = None
+            if dcfg is not None:
+                breakdown["kv_pool"].update({
+                    "draft_model": sv.draft_model,
+                    "draft_num_blocks": sv.num_draft_blocks(plan.seq_len),
+                    "draft_pool_bytes": sv.draft_pool_bytes(
+                        dcfg, 1, plan.seq_len, plan.kv_dtype
+                    ),
+                    "draft_pool_bytes_per_device": sv.draft_pool_bytes(
+                        dcfg, tp, plan.seq_len, plan.kv_dtype
+                    ),
+                })
+                if dcfg.padded_vocab_size != plan.cfg.padded_vocab_size:
+                    findings.append(_finding(
+                        plan, "bad-serving-config",
+                        f"draft_model={sv.draft_model!r} padded vocab "
+                        f"{dcfg.padded_vocab_size} != target "
+                        f"{plan.cfg.padded_vocab_size}: the rejection "
+                        "verify compares token ids, so drafter and "
+                        "verifier must share a vocabulary "
+                        "(ServingEngine refuses this config)",
+                    ))
         _check_host_tier(plan, sv, findings, breakdown)
         _check_kernel_tuning(plan, findings, breakdown, bb)
         pp = _serving_pp(plan)
@@ -1310,6 +1371,18 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--token-budget", type=int, default=None,
                      help="unified-step token budget (default: max_batch + "
                      "prefill_chunk)")
+    srv.add_argument("--decode-chunk", type=int, default=8)
+    srv.add_argument("--spec-k", type=int, default=0,
+                     help="speculative draft length (exact-match verify at "
+                     "temperature 0, rejection-sampled verify above)")
+    srv.add_argument("--temperature", type=float, default=0.0)
+    srv.add_argument("--draft-model", default=None, metavar="NAME",
+                     help="registry name of a small draft model; audits "
+                     "the draft kv-pool carve-out (draft_* breakdown "
+                     "fields) and the target-pool headroom left after it")
+    srv.add_argument("--draft-share", type=float, default=0.25,
+                     help="fraction of a bounded --max-blocks budget "
+                     "carved out for the draft pool (default 0.25)")
     srv.add_argument("--host-pool-mib", type=int, default=0,
                      help="host-RAM KV block tier size in MiB (0 = off): "
                      "preempted sequences swap their blocks to pinned host "
@@ -1394,6 +1467,11 @@ def _plan_from_args(args) -> PlanSpec:
             max_batch=args.max_batch,
             prefill_chunk=args.prefill_chunk,
             token_budget=args.token_budget,
+            decode_chunk=args.decode_chunk,
+            spec_k=args.spec_k,
+            temperature=args.temperature,
+            draft_model=args.draft_model,
+            draft_share=args.draft_share,
             # the pool dtype rides --kv-dtype (e.g. int8 for the quantized
             # pool: payload + scale bytes both audited); unknown names
             # surface as bad-serving-config, exactly like the engine

@@ -419,7 +419,14 @@ def reachable_serving_set(
         ("mixed", (int(max_batch), int(token_budget)))
     }
     if serving.spec_k:
-        sigs.add(("verify", (int(max_batch), int(serving.spec_k) + 1)))
+        # spec_verify_sampled() routes between the pinned exact-match
+        # verify (greedy) and the rejection-sampled verify (temperature>0)
+        label = "verify_sample" if serving.spec_verify_sampled() else "verify"
+        sigs.add((label, (int(max_batch), int(serving.spec_k) + 1)))
+        if serving.draft_model:
+            # draft model: mixed-step mirror + ragged catch-up/scan
+            sigs.add(("draft_mixed", (int(max_batch), int(token_budget))))
+            sigs.add(("draft_scan", (int(max_batch), int(serving.spec_k) + 2)))
     if serving.decode_chunk > 1:
         sigs.add(("decode_chunk", (int(max_batch), int(serving.decode_chunk))))
     else:
@@ -757,6 +764,13 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--temperature", type=float, default=0.0)
     srv.add_argument("--top-k", type=int, default=None)
     srv.add_argument("--top-p", type=float, default=None)
+    srv.add_argument("--draft-model", default=None, metavar="NAME",
+                     help="registry name of a small draft model; traces "
+                          "the draft_mixed/draft_scan executables and the "
+                          "draft kv-pool carve-out")
+    srv.add_argument("--draft-share", type=float, default=0.25,
+                     help="fraction of a bounded block budget carved out "
+                          "for the draft pool (default 0.25)")
     srv.add_argument("--kv-dtype", default="auto",
                      help="paged-pool storage dtype (e.g. int8)")
     seq = ap.add_argument_group("sequential generate() path")
@@ -831,6 +845,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             temperature=args.temperature,
             top_k=args.top_k,
             top_p=args.top_p,
+            draft_model=args.draft_model,
+            draft_share=args.draft_share,
             kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
         )
         engine = trace_serving(

@@ -1129,6 +1129,14 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--token-budget", type=int, default=None)
     srv.add_argument("--decode-chunk", type=int, default=8)
     srv.add_argument("--spec-k", type=int, default=0)
+    srv.add_argument("--temperature", type=float, default=0.0,
+                     help="0 budgets the exact-match verify; >0 the "
+                          "rejection-sampled verify executable")
+    srv.add_argument("--top-p", type=float, default=None)
+    srv.add_argument("--draft-model", default=None, metavar="NAME",
+                     help="budget the draft-model scan/mixed executables "
+                          "and the carved-out draft pool")
+    srv.add_argument("--draft-share", type=float, default=0.25)
     srv.add_argument("--kv-dtype", default="auto",
                      help="paged-pool storage dtype (e.g. int8)")
     seq = ap.add_argument_group("sequential generate() path")
@@ -1213,6 +1221,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             token_budget=args.token_budget,
             decode_chunk=args.decode_chunk,
             spec_k=args.spec_k,
+            temperature=args.temperature,
+            top_p=args.top_p,
+            draft_model=args.draft_model,
+            draft_share=args.draft_share,
             kv_dtype=None if args.kv_dtype == "auto" else args.kv_dtype,
         )
         engine = trace_serving(
@@ -1230,7 +1242,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     name = args.model or Path(args.config).stem
     mesh_tag = "".join(
         t for t in (f"@tp{args.tp}" if args.tp > 1 else "",
-                    f"@pp{args.pp}" if args.pp > 1 else "")
+                    f"@pp{args.pp}" if args.pp > 1 else "",
+                    f"@spec{args.spec_k}" if args.spec_k else "",
+                    "@draft" if args.draft_model else "")
     )
     origin = f"{name}{mesh_tag}"
     report = flow_preflight(

@@ -79,10 +79,24 @@ def build_parser():
                     "(1 = per-step engine)")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative serving: draft K tokens per request "
-                    "by n-gram prompt lookup and verify them in one ragged "
-                    "forward over the paged cache, emitting up to K+1 "
-                    "tokens per sync; greedy only (temperature 0), exact "
+                    "(n-gram prompt lookup, or --draft-model where the "
+                    "lookup misses) and verify them in one ragged forward "
+                    "over the paged cache, emitting up to K+1 tokens per "
+                    "sync.  At temperature 0 the verify is exact-match "
+                    "(token-identical to plain decode); at temperature>0 "
+                    "it is rejection-sampled (accept w.p. min(1, "
+                    "p_verify/p_draft), else resample the residual) and "
+                    "preserves the per-step sampling distribution "
                     "(0 disables)")
+    ap.add_argument("--draft-model", default=None, metavar="NAME",
+                    help="registry name of a small draft model for "
+                    "speculative serving (needs --spec-k > 0): drafts "
+                    "spec_k tokens in one jitted greedy scan from a "
+                    "second paged pool carved out of the block budget "
+                    "(ServingConfig.draft_share) wherever the n-gram "
+                    "lookup misses.  The engine random-inits the draft "
+                    "params — useful accept rates need a draft trained "
+                    "on the target's distribution")
     ap.add_argument("--no-double-buffer", action="store_true",
                     help="do not overlap a decode chunk's host read with "
                     "the next chunk's on-device compute")
@@ -121,6 +135,10 @@ def build_parser():
                     "per-device-kind table in serving/host_tier.py)")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="engine-wide sampling temperature (0 = greedy)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="top-k sampling filter (temperature>0)")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="nucleus sampling filter (temperature>0)")
     ap.add_argument("--policy", default="fcfs",
                     choices=("fcfs", "priority", "fair", "deadline"),
                     help="scheduling policy (serving/policy.py): admission "
@@ -224,6 +242,9 @@ def make_serving_config(args, admission_queue=None):
         double_buffer=not args.no_double_buffer,
         prefix_caching=not args.no_prefix_cache,
         temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        draft_model=args.draft_model,
         kv_dtype="int8" if args.kv_dtype == "int8" else None,
         admission_queue=admission_queue,
         host_pool_mib=args.host_pool_mib,

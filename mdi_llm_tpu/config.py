@@ -472,10 +472,32 @@ class ServingConfig:
     double_buffer: bool = True  # dispatch chunk N+1 (chained on device
     # arrays) before reading chunk N, so the host read overlaps compute;
     # engaged only while no prefill/admission/preemption work is pending
-    spec_k: int = 0  # n-gram speculative draft length for serving decode:
-    # per-slot prompt-lookup drafts verified in ONE ragged forward over the
-    # paged cache, emitting up to K+1 tokens per sync.  Greedy only
-    # (temperature must be 0) — exact, token-identical to plain decode
+    spec_k: int = 0  # speculative draft length for serving decode: per-slot
+    # drafts (prompt n-gram lookup, or the optional draft model below)
+    # verified in ONE ragged forward over the paged cache, emitting up to
+    # K+1 tokens per sync.  At temperature 0 the verify is exact-match
+    # (token-identical to plain decode); at temperature>0 it is the
+    # rejection-sampled verify (accept draft token w.p.
+    # min(1, p_verify/p_draft), else resample the residual) — emitted
+    # tokens are distributed exactly as the per-step sampler's.
+    # `spec_sampled` gates the verify rule.  None → auto: exact-match at
+    # temperature 0, rejection-sampled at temperature>0.  False pins the
+    # OLD greedy-only exact-match path (temperature>0 with spec_k then
+    # refuses, naming this flag); True forces the sampled rule even at
+    # temperature 0 (same tokens as exact-match there, by construction).
+    spec_sampled: Optional[bool] = None
+    # optional small draft model (a `Config.from_name` registry name) that
+    # drafts spec_k tokens in one jitted greedy scan for slots where
+    # `ngram_draft` misses.  It shares the paged-pool budget: a second
+    # KVPool holds its blocks, carved out of `max_blocks` by `draft_share`
+    # when the pool is bounded (full coverage when max_blocks is None).
+    draft_model: Optional[str] = None
+    # fraction of a bounded `max_blocks` budget handed to the draft pool
+    # (block-count partition of the shared range; the draft model's
+    # smaller per-block bytes make its slice cheap).  mdi-audit refuses a
+    # share that leaves the TARGET pool below one slot's
+    # chunk-reservation headroom (bad-serving-config).
+    draft_share: float = 0.25
     # sampling (engine-wide: the decode step is one jitted batch) ------------
     temperature: float = 0.0
     top_k: Optional[int] = None
@@ -542,15 +564,6 @@ class ServingConfig:
             return int(self.token_budget)
         return self.max_batch + max(1, self.prefill_chunk)
 
-    def num_pool_blocks(self, max_seq_length: int) -> int:
-        """Pool size in blocks: `max_blocks` when set, else full coverage
-        (1 trash block + max_batch × ceil(max_seq_length / block_size)) —
-        the same default `serving.engine.ServingEngine` computes."""
-        if self.max_blocks is not None:
-            return int(self.max_blocks)
-        per_seq = -(-int(max_seq_length) // self.block_size)
-        return 1 + self.max_batch * per_seq
-
     def reserve_headroom_blocks(self) -> int:
         """Worst-case blocks one live slot holds AHEAD of its written tokens
         under K-step chunk reservation (`decode_chunk`, doubled while a
@@ -564,6 +577,73 @@ class ServingConfig:
         if self.double_buffer and self.spec_k == 0:
             ahead += max(1, self.decode_chunk)
         return -(-ahead // self.block_size) + 1
+
+    def spec_verify_sampled(self) -> bool:
+        """True iff the speculative verify uses the rejection-sampling
+        rule (accept w.p. min(1, p_verify/p_draft), else resample the
+        residual) instead of exact greedy match.  Auto (`spec_sampled` is
+        None): sampled iff temperature > 0 — so greedy serving keeps the
+        bit-identical exact-match path and sampling serving preserves the
+        per-step distribution.  `spec_sampled=False` pins exact-match
+        (the engine refuses temperature>0 with spec_k on that pin);
+        `spec_sampled=True` forces the sampled rule everywhere."""
+        if self.spec_sampled is not None:
+            return bool(self.spec_sampled)
+        return self.temperature != 0.0
+
+    def num_draft_blocks(self, max_seq_length: int) -> int:
+        """Draft-pool size in blocks (0 when no `draft_model`): the draft
+        model's slice of the shared paged-pool budget.  Bounded pools
+        (`max_blocks` set) partition the block range — the draft pool
+        takes `draft_share` of `max_blocks` (at least 2: trash + one
+        usable block) and `num_pool_blocks` hands the target the rest.
+        Unbounded pools give the draft full coverage, same formula as the
+        target's (the draft model's smaller per-block bytes keep that
+        cheap)."""
+        if not self.draft_model:
+            return 0
+        if self.max_blocks is not None:
+            return max(2, int(int(self.max_blocks) * self.draft_share))
+        per_seq = -(-int(max_seq_length) // self.block_size)
+        return 1 + self.max_batch * per_seq
+
+    def num_pool_blocks(self, max_seq_length: int) -> int:
+        """TARGET pool size in blocks: `max_blocks` when set (minus the
+        draft pool's `num_draft_blocks` slice when a draft model shares
+        the bounded budget), else full coverage (1 trash block +
+        max_batch × ceil(max_seq_length / block_size)) — the same default
+        `serving.engine.ServingEngine` computes."""
+        if self.max_blocks is not None:
+            return int(self.max_blocks) - self.num_draft_blocks(max_seq_length)
+        per_seq = -(-int(max_seq_length) // self.block_size)
+        return 1 + self.max_batch * per_seq
+
+    def draft_config(self) -> Optional["Config"]:
+        """The draft model's `Config` (registry lookup on `draft_model`),
+        or None — shared by the engine, mdi-audit's byte accounting and
+        `trace_serving`'s abstract construction so all three price the
+        same architecture."""
+        if not self.draft_model:
+            return None
+        return Config.from_name(self.draft_model)
+
+    def draft_pool_bytes(
+        self,
+        cfg: "Config",
+        tp: int = 1,
+        max_seq_length: Optional[int] = None,
+        dtype="bfloat16",
+    ) -> int:
+        """Per-device HBM bytes of the DRAFT paged pool for draft model
+        `cfg` (pass `draft_config()`): `num_draft_blocks` × the draft
+        architecture's itemized `block_bytes` — byte-exact against the
+        live engine's second pool, the contract `pool_bytes` keeps for
+        the target.  0 when no draft model."""
+        if not self.draft_model:
+            return 0
+        max_seq = int(min(max_seq_length or cfg.block_size, cfg.block_size))
+        n_blocks = self.num_draft_blocks(max_seq)
+        return n_blocks * self.block_bytes(cfg, dtype, tp=tp)["total_bytes"]
 
     def resolved_kv_dtype(self, default="bfloat16") -> str:
         """The pool's storage dtype NAME: `kv_dtype` when set, else the

@@ -1141,7 +1141,8 @@ class Generator:
         """A stateful conversation handle with cross-turn KV reuse."""
         return ChatSession(self)
 
-    def serve(self, serving=None, obs=None, policy=None, **knobs):
+    def serve(self, serving=None, obs=None, policy=None, draft_gen=None,
+              **knobs):
         """A paged-KV continuous-batching engine bound to this model
         (serving/engine.py): request queue, unified token-budget steps
         (decode lanes + prefill chunks in ONE ragged forward per
@@ -1175,6 +1176,11 @@ class Generator:
         TTFT-deadline EDF — while dispatch shapes and the sync cadence
         stay structurally identical (docs/serving.md "Scheduling
         policies").
+
+        `draft_gen` takes a Generator for `ServingConfig.draft_model`'s
+        checkpoint (same vocabulary as this model); None lets the engine
+        random-init the named config — fine for benchmarks and tests,
+        useless acceptance rates on real text.
         """
         from mdi_llm_tpu.config import ServingConfig
         from mdi_llm_tpu.serving.engine import (
@@ -1198,7 +1204,8 @@ class Generator:
             from mdi_llm_tpu.serving.pipeline import PipelinedServingEngine
 
             return PipelinedServingEngine(self, serving, obs=obs, policy=policy)
-        return ServingEngine(self, serving, obs=obs, policy=policy)
+        return ServingEngine(self, serving, obs=obs, policy=policy,
+                             draft_gen=draft_gen)
 
 
 

@@ -256,6 +256,30 @@ class ServingObserver:
             buckets=RESTORE_WAIT_BUCKETS_MS,
         ).observe(seconds * 1e3)
 
+    def spec(self, drafted: int, accepted: int, source: str) -> None:  # mdi-thread: engine
+        """One lane's speculative verify outcome, split by draft source
+        (``"ngram"`` prompt lookup vs ``"model"`` draft model): per-source
+        and total drafted/accepted counters plus the lifetime
+        `serving_spec_accept_rate` gauge.  Called per live lane per verify
+        round at the round's boundary sync — host-side counter bumps only."""
+        m = self.metrics
+        m.counter(f"serving_spec_drafted_{source}_total",
+                  f"draft tokens proposed by the {source} drafter"
+                  ).inc(drafted)
+        m.counter(f"serving_spec_accepted_{source}_total",
+                  f"{source}-drafted tokens accepted by verify"
+                  ).inc(accepted)
+        d = m.counter("serving_spec_drafted_total",
+                      "draft tokens scored by speculative verify")
+        a = m.counter("serving_spec_accepted_total",
+                      "draft tokens accepted by speculative verify")
+        d.inc(drafted)
+        a.inc(accepted)
+        if d.value:
+            m.gauge("serving_spec_accept_rate",
+                    "accepted/drafted over the observer's lifetime"
+                    ).set(a.value / d.value)
+
     def prefill_chunk(self, rid: str, n_tokens: int) -> None:  # mdi-thread: engine
         self.tracer.prefill_chunk(rid, n_tokens, self.now)
         self.metrics.counter("serving_prefill_tokens_total",

@@ -129,6 +129,30 @@ def sampling_operands(
     return jnp.asarray(t, jnp.float32), jnp.asarray(p, jnp.float32)
 
 
+def filtered_logits(
+    logits: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    mode: str,
+    top_k: Optional[int] = None,
+) -> jnp.ndarray:
+    """The f32 logits `sample_traced` hands to `jax.random.categorical`:
+    temperature-scaled, then nucleus- or top-k-filtered per `mode`.  Split
+    out so the speculative rejection verify (`speculative_verify`) draws
+    from EXACTLY the distribution the per-step sampler uses — softmaxing
+    this array is the verify distribution p."""
+    logits = logits.astype(jnp.float32)
+    if mode == "greedy":
+        return logits
+    logits = logits / temperature
+    if mode == "top_p":
+        logits = _nucleus_filter(logits, top_p)
+    elif top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        logits = _topk_filter(logits, top_k)
+    return logits
+
+
 def sample_traced(
     logits: jnp.ndarray,
     key: jax.Array,
@@ -146,9 +170,89 @@ def sample_traced(
     with jax.named_scope(f"sample_{mode}"):
         if mode == "greedy":
             return jnp.argmax(logits, axis=-1)
-        logits = logits.astype(jnp.float32) / temperature
-        if mode == "top_p":
-            logits = _nucleus_filter(logits, top_p)
-        elif top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
-            logits = _topk_filter(logits, top_k)
-        return jax.random.categorical(key, logits, axis=-1)
+        return jax.random.categorical(
+            key,
+            filtered_logits(logits, temperature, top_p, mode=mode, top_k=top_k),
+            axis=-1,
+        )
+
+
+def speculative_verify(
+    logits: jnp.ndarray,
+    draft: jnp.ndarray,
+    draft_len: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    mode: str,
+    top_k: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rejection-sampled speculative verify for DETERMINISTIC drafts
+    (n-gram lookup or a greedy draft model — `p_draft` is a one-hot).
+
+    The standard acceptance rule (Leviathan et al.; Chen et al.) accepts
+    draft token d with probability `min(1, p_verify(d) / p_draft(d))` and
+    otherwise resamples from the normalized residual
+    `max(p_verify - p_draft, 0)`.  With one-hot `p_draft` that reduces to:
+    accept d w.p. `p(d)`, else draw from p with d masked out — so each
+    emitted token is distributed EXACTLY as the per-step sampler's
+    (distribution preservation, draw-for-draw), and at temperature 0
+    (`p` one-hot too) it degenerates to exact-match accept.
+
+    Args (all traced; `mode`/`top_k` are the only static knobs, shared
+    with `sample_traced` so the compile set stays fixed):
+      logits:    (B, K+1, V) — row i is the verify model's successor
+                 distribution of input position i (input = pending token
+                 followed by the K drafted tokens).
+      draft:     (B, K) int32 — drafted tokens (draft[:, i] proposes
+                 input position i+1).
+      draft_len: (B,) int32 — valid drafts per row (0..K; rows with 0
+                 drafts reduce to one plain sample from position 0).
+      key:       PRNG key consumed for this verify step.
+
+    Returns (out_tokens (B, K+1) int32, n_emit (B,) int32): row b emits
+    `out_tokens[b, :n_emit[b]]` — the accepted draft prefix followed by
+    one resampled (on rejection) or bonus (all accepted) token.
+    """
+    B, K1, V = logits.shape
+    K = K1 - 1
+    f = filtered_logits(logits, temperature, top_p, mode=mode, top_k=top_k)
+    with jax.named_scope("speculative_verify"):
+        if mode == "greedy":
+            # exact-match accept: emitted greedy successors vs the draft
+            g = jnp.argmax(f, axis=-1).astype(jnp.int32)  # (B, K+1)
+            match = (g[:, :K] == draft) & (
+                jnp.arange(K)[None, :] < draft_len[:, None]
+            )
+            a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=-1), axis=-1)
+            return g, a.astype(jnp.int32) + 1
+        probs = jax.nn.softmax(f, axis=-1)  # (B, K+1, V) — the verify p
+        ku, kr = jax.random.split(key)
+        u = jax.random.uniform(ku, (B, max(K, 1)))[:, :K]
+        p_draft_tok = jnp.take_along_axis(
+            probs[:, :K, :], draft[..., None], axis=-1
+        )[..., 0]  # (B, K): p_i(d_i)
+        valid = jnp.arange(K)[None, :] < draft_len[:, None]
+        accept = (u < p_draft_tok) & valid
+        # accepted length = leading run of accepts
+        a = jnp.sum(
+            jnp.cumprod(accept.astype(jnp.int32), axis=-1), axis=-1
+        ).astype(jnp.int32)  # (B,)
+        # position a's draw: the residual (rejected token masked) when a
+        # rejection happened, the untouched bonus distribution otherwise
+        row_f = jnp.take_along_axis(f, a[:, None, None], axis=1)[:, 0, :]
+        rejected = a < draft_len  # (B,)
+        rej_tok = jnp.take_along_axis(
+            draft, jnp.minimum(a, max(K - 1, 0))[:, None], axis=1
+        )[:, 0] if K > 0 else jnp.zeros((B,), jnp.int32)
+        masked = jnp.where(
+            jnp.arange(V)[None, :] == rej_tok[:, None], -jnp.inf, row_f
+        )
+        row_f = jnp.where(rejected[:, None], masked, row_f)
+        last = jax.random.categorical(kr, row_f, axis=-1).astype(jnp.int32)
+        cols = jnp.arange(K1)[None, :]
+        padded = jnp.pad(draft, ((0, 0), (0, 1)))  # (B, K+1)
+        out = jnp.where(cols < a[:, None], padded, 0)
+        out = jnp.where(cols == a[:, None], last[:, None], out)
+        return out.astype(jnp.int32), a + 1

@@ -42,9 +42,17 @@ finished lanes on device — and the host reads tokens once per K steps
 instead of per token; with `double_buffer` chunk N+1 is dispatched
 (chained on device arrays) before chunk N's tokens are read, so the read
 overlaps compute.  `spec_k=K` adds batched speculative decoding: per-slot
-n-gram drafts verified in one ragged multi-query forward over the paged
-cache (`ops/paged_attention.py`), emitting up to K+1 tokens per sync —
-greedy-only, exact.
+drafts verified in one ragged multi-query forward over the paged cache
+(`ops/paged_attention.py`), emitting up to K+1 tokens per sync.  At
+temperature 0 the verify is exact-match accept against the greedy
+successors (bit-identical streams); at temperature>0 it is the
+rejection-sampled accept/resample rule (`ops/sampling.speculative_verify`)
+— each emitted token distributed exactly as the per-step sampler's.
+Drafts come from prompt lookup (`ngram_draft`), and optionally from a
+small draft model (`ServingConfig.draft_model`) running over its OWN
+paged pool carved out of the block budget: it mirrors every mixed step to
+keep its KV in lockstep and proposes K greedy tokens in one jitted
+catch-up + scan (`_draft_scan_fn`) for lanes where the n-gram misses.
 
 Tensor-parallel serving (docs/perf.md "Distributed serving"): built from a
 Generator with a tp mesh, the SAME engine serves sharded — model weights
@@ -101,6 +109,7 @@ from mdi_llm_tpu.ops.sampling import (
     sample_mode,
     sample_traced,
     sampling_operands,
+    speculative_verify,
 )
 from mdi_llm_tpu.serving.kv_pool import KVPool
 from mdi_llm_tpu.serving.scheduler import Request, Scheduler, SequenceState
@@ -200,6 +209,12 @@ class ServingStats:
     _occ_n: int = 0
     spec_drafted: int = 0  # draft tokens scored by speculative verify
     spec_accepted: int = 0  # draft tokens accepted (emitted without a step)
+    # per-source split of the totals above: n-gram prompt lookup vs the
+    # optional draft model (zero when no draft_model is configured)
+    spec_drafted_ngram: int = 0
+    spec_accepted_ngram: int = 0
+    spec_drafted_model: int = 0
+    spec_accepted_model: int = 0
     requests_finished: int = 0
     preemptions: int = 0
     # open-system fields (server/frontend.py fills them; replay runs keep
@@ -312,6 +327,12 @@ class ServingStats:
             "padded_token_frac": round(self.padded_token_frac, 4),
             "mixed_batch_occupancy": round(self.mixed_batch_occupancy, 4),
             "spec_accept_rate": round(self.spec_accept_rate, 4),
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_drafted_ngram": self.spec_drafted_ngram,
+            "spec_accepted_ngram": self.spec_accepted_ngram,
+            "spec_drafted_model": self.spec_drafted_model,
+            "spec_accepted_model": self.spec_accepted_model,
             "kv_block_utilization_mean": round(self.kv_utilization_mean, 4),
             "kv_block_utilization_peak": round(self.kv_utilization_peak, 4),
             "prefix_cache_hits": self.prefix_cache_hits,
@@ -350,7 +371,7 @@ class ServingEngine:
     _kv_block_axis = 1
 
     def __init__(self, gen: Generator, serving: ServingConfig, obs=None,
-                 policy=None):
+                 policy=None, draft_gen: Optional[Generator] = None):
         validate_serving_mesh(gen.mesh)  # serve() checks too; direct
         # constructions must hit the same wall before the pool allocates
         self.gen = gen
@@ -392,11 +413,27 @@ class ServingEngine:
             raise ValueError("decode_chunk must be >= 1")
         if serving.spec_k < 0:
             raise ValueError("spec_k must be >= 0")
-        if serving.spec_k and serving.temperature != 0.0:
+        if (
+            serving.spec_k
+            and serving.temperature != 0.0
+            and not serving.spec_verify_sampled()
+        ):
+            # only the OLD exact-match verify is greedy-only; the default
+            # (spec_sampled=None → auto) switches to the rejection-sampled
+            # verify at temperature>0, which preserves the sampler's
+            # distribution draw-for-draw
             raise ValueError(
-                "speculative serving (spec_k > 0) requires temperature=0: "
-                "verify emits greedy successors, so only greedy streams are "
-                "exact (the shared_prefill reproducibility rule)"
+                "spec_sampled=False pins the exact-match verify, which "
+                "emits greedy successors and is only exact at "
+                "temperature=0 — drop spec_sampled=False (auto selects "
+                "the rejection-sampled verify at temperature>0) or set "
+                "temperature=0"
+            )
+        if serving.draft_model and not serving.spec_k:
+            raise ValueError(
+                "draft_model is a drafter for speculative serving: set "
+                "spec_k > 0 (the draft scan proposes up to spec_k tokens "
+                "per slot per verify)"
             )
         # pool storage dtype: kv_dtype=None keeps the fp path untouched
         # (gen.cache_dtype, bit-identical to before the knob existed);
@@ -493,6 +530,18 @@ class ServingEngine:
         self._sample_mode = sample_mode(
             serving.temperature, serving.top_k, serving.top_p
         )
+        # optional draft model: a second, smaller transformer with its OWN
+        # KVPool carved out of the block budget (ServingConfig.
+        # num_draft_blocks owns the split).  All attributes stay None
+        # without draft_model, so every existing path is untouched.
+        self.draft_gen: Optional[Generator] = None
+        self.draft_pool: Optional[KVPool] = None
+        self._draft_params = None
+        self._draft_kv = None
+        self._draft_kv_sharding = None
+        self._draft_tables: Optional[np.ndarray] = None
+        if serving.draft_model:
+            self._init_draft(draft_gen)
         self.stats = ServingStats()
         self._results: Dict[str, List[int]] = {}
         self._stream_cb = None
@@ -564,6 +613,138 @@ class ServingEngine:
         return self.gen._place_paged_kv(transformer.init_paged_kv_cache(
             self.gen.cfg, num_blocks, bs, dtype=self._pool_dtype
         ))
+
+    # -- draft model (speculative drafting over a second paged pool) ---------
+
+    def _init_draft(self, draft_gen: Optional[Generator]) -> None:
+        """Build the draft Generator and its own paged pool.  The block
+        split is `ServingConfig.num_draft_blocks` / `num_pool_blocks` —
+        the same formulas mdi-audit's `draft_*` breakdown budgets, so the
+        engine and the estimator can never disagree on the carve-out.
+        The draft pool has no prefix cache and no host tier: draft KV is
+        always recomputable from the token list, so retire/preempt drop
+        it wholesale (`Scheduler._release_draft`)."""
+        serving = self.cfg
+        tcfg = self.gen.cfg
+        if draft_gen is not None:
+            dcfg = draft_gen.cfg  # a caller-built draft wins over from_name
+        else:
+            dcfg = serving.draft_config()
+        if dcfg.padded_vocab_size != tcfg.padded_vocab_size:
+            raise ValueError(
+                f"draft_model {serving.draft_model!r} has padded vocab "
+                f"{dcfg.padded_vocab_size}, the target has "
+                f"{tcfg.padded_vocab_size}: the rejection verify compares "
+                "token ids, so drafter and verifier must share a vocabulary"
+            )
+        if dcfg.block_size < self.max_seq_length:
+            raise ValueError(
+                f"draft_model {serving.draft_model!r} context window "
+                f"{dcfg.block_size} is smaller than the engine's "
+                f"max_seq_length {self.max_seq_length}: the draft must "
+                "follow every lane to the window edge"
+            )
+        if draft_gen is None:
+            draft_gen = self._build_draft_gen(dcfg)
+        self.draft_gen = draft_gen
+        self._draft_params = draft_gen.params
+        self._draft_kv_sharding = (
+            None if draft_gen._paged_kv_sharding is None
+            else (
+                draft_gen._paged_kv_sharding,
+                draft_gen._paged_kv_scale_sharding,
+            )
+        )
+        n_blocks = serving.num_draft_blocks(self.max_seq_length)
+        self.draft_pool = KVPool(
+            n_blocks, serving.block_size, prefix_caching=False
+        )
+        self.scheduler.draft_pool = self.draft_pool
+        self._draft_kv = self._init_draft_kv(n_blocks, serving.block_size)
+        self._draft_tables = np.zeros(
+            (serving.max_batch, self.max_blocks_per_seq), np.int32
+        )
+
+    def _build_draft_gen(self, dcfg) -> Generator:
+        """Default draft Generator when the caller did not hand one in:
+        random init at the target's (floating) parameter dtype — real
+        checkpoints come through `Generator.serve(draft_gen=...)`, which
+        cli/serve.py wires when `--draft-model` names a downloaded model.
+        On an abstract engine (mdi-ir / mdi-flow) the draft is abstract
+        too: zero bytes, zero device work."""
+        gen = self.gen
+        if getattr(gen, "abstract", False):
+            from mdi_llm_tpu.analysis.plan import abstract_params
+
+            return Generator(
+                dcfg, abstract_params(dcfg),
+                max_seq_length=self.max_seq_length, mesh=gen.mesh,
+                abstract=True,
+            )
+        dt = jnp.bfloat16
+        for leaf in jax.tree_util.tree_leaves(gen.params):
+            d = jnp.dtype(leaf.dtype)
+            if jnp.issubdtype(d, jnp.floating):
+                dt = d
+                break
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(0), dtype=dt)
+        return Generator(
+            dcfg, dparams, max_seq_length=self.max_seq_length,
+            cache_dtype=gen.cache_dtype, mesh=gen.mesh,
+            scan_unroll=gen.scan_unroll,
+        )
+
+    def _init_draft_kv(self, num_blocks: int, bs: int):
+        """The draft model's paged pool, `_init_pool`'s exact shape
+        discipline (tp sharding, pool dtype, abstract ShapeDtypeStructs)
+        applied to the draft config."""
+        dgen = self.draft_gen
+        if getattr(self.gen, "abstract", False):
+            tmpl = jax.eval_shape(
+                lambda: transformer.init_paged_kv_cache(
+                    dgen.cfg, num_blocks, bs, dtype=self._pool_dtype
+                )
+            )
+            pool_sh = dgen._paged_kv_sharding
+            scale_sh = dgen._paged_kv_scale_sharding
+
+            def leaf(l):
+                if pool_sh is not None:
+                    return jax.ShapeDtypeStruct(
+                        l.shape, l.dtype,
+                        sharding=pool_sh if l.ndim == 5 else scale_sh,
+                    )
+                return jax.ShapeDtypeStruct(l.shape, l.dtype)
+
+            return jax.tree_util.tree_map(leaf, tmpl)
+        return dgen._place_paged_kv(transformer.init_paged_kv_cache(
+            dgen.cfg, num_blocks, bs, dtype=self._pool_dtype
+        ))
+
+    def _ensure_draft_blocks(self, seq: SequenceState, n_tokens: int) -> bool:
+        """Grow `seq`'s draft-pool table to cover `n_tokens` positions,
+        WITHOUT preemption (the draft pool is a fixed carve-out; a lane it
+        cannot cover simply keeps the n-gram drafter)."""
+        pool = self.draft_pool
+        need = pool.blocks_needed(min(n_tokens, self.max_seq_length))
+        while len(seq.draft_blocks) < need:
+            got = pool.alloc(1)
+            if got is None:
+                return False
+            seq.draft_blocks.extend(got)
+        return True
+
+    def _sync_draft_tables(self, seqs: Sequence[SequenceState]) -> np.ndarray:
+        """Block table into the DRAFT pool, rebuilt per dispatch (draft
+        dispatches are per-round, not per-token — simple beats the
+        incremental machinery here).  Zero rows redirect every absent or
+        stale lane's writes to the draft pool's trash block."""
+        t = self._draft_tables
+        t[:] = 0
+        for seq in seqs:
+            n = len(seq.draft_blocks)
+            t[seq.slot, :n] = seq.draft_blocks
+        return t
 
     # -- host-RAM tier (serving/host_tier.py) --------------------------------
 
@@ -965,6 +1146,137 @@ class ServingEngine:
             self._fns[key_] = verify
         return self._fns[key_]
 
+    def _verify_sample_fn(self, B: int, T: int):
+        """Rejection-sampled speculative verify: the same ragged
+        multi-query forward as `_verify_fn`, but the T-1 drafted tokens
+        are accepted/resampled per position against the EXACT filtered
+        distribution `sample_traced` draws from (`ops/sampling.
+        speculative_verify`) — temperature/top_p ride as traced operands,
+        so the temperature-sweep contract (zero post-warmup recompiles)
+        carries over from the per-step sampler.  Returns (out, n_emit,
+        kv, key): row b emits out[b, :n_emit[b]] — its accepted draft
+        prefix plus one resampled/bonus token."""
+        key_ = ("verify_sample", B, T)
+        if key_ not in self._fns:
+            gen = self.gen
+            use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
+            shard = self._paged_shard
+            kv_sharding = self._kv_sharding_pair
+
+            @partial(
+                jax.jit, donate_argnums=(2,),
+                static_argnames=("mode", "top_k"),
+            )
+            def verify_sample(params, tokens, kv, tables, pos0, draft_len,
+                              key, temperature, top_p, mode, top_k):
+                logits, kv = transformer.forward(
+                    gen.cfg, params, tokens, pos0, kv=kv, rope=gen.rope,
+                    moe_impl=gen._moe_impl, unroll=gen.scan_unroll,
+                    paged_tables=tables, paged_kernel=use_kernel,
+                    paged_shard=shard,
+                )
+                kv = _pin_kv(kv, kv_sharding)
+                key, sub = jax.random.split(key)
+                out, n_emit = speculative_verify(
+                    logits, tokens[:, 1:], draft_len, sub, temperature,
+                    top_p, mode=mode, top_k=top_k,
+                )
+                return out, n_emit, kv, key
+
+            self._fns[key_] = verify_sample
+        return self._fns[key_]
+
+    def _draft_mixed_fn(self, B: int, T: int):
+        """The draft model's mirror of `_mixed_fn`: the SAME packed ragged
+        batch (tokens, positions, slot spans) forwarded through the DRAFT
+        pool, so the draft's KV tracks the target's feed positions in
+        lockstep through prefill and decode feeds.  No sampling head and
+        nothing to sync — the dispatch rides asynchronously behind the
+        target step's boundary read."""
+        key_ = ("draft_mixed", self.cfg.draft_model, B, T)
+        if key_ not in self._fns:
+            dgen = self.draft_gen
+            use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
+            shard = self._paged_shard
+            kv_sharding = self._draft_kv_sharding
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def draft_mixed(params, tokens, kv, tables, pos, q_slot,
+                            q_start, q_len):
+                _, kv = transformer.forward(
+                    dgen.cfg, params, tokens, pos, kv=kv, rope=dgen.rope,
+                    moe_impl=dgen._moe_impl, unroll=dgen.scan_unroll,
+                    paged_tables=tables, paged_kernel=use_kernel,
+                    paged_ragged=(q_slot, q_start, q_len),
+                    paged_shard=shard,
+                )
+                return _pin_kv(kv, kv_sharding)
+
+            self._fns[key_] = draft_mixed
+        return self._fns[key_]
+
+    def _draft_scan_fn(self, B: int, F: int):
+        """Draft K = F-2 tokens per lane in ONE jitted call against the
+        DRAFT pool: a ragged catch-up forward over the lane's last `n_in`
+        un-drafted tokens (pending token included — F covers the worst
+        post-accept gap of K+1, so n_in <= F), then a K-1 step greedy
+        scan feeding each proposal back.  Greedy drafting keeps `p_draft`
+        one-hot — the assumption `speculative_verify`'s acceptance rule
+        is derived under.  Rows with n_in=0 are dead lanes: zero table
+        rows redirect their writes to the draft pool's trash block.
+        Catch-up positions past n_in hold garbage KV only at positions
+        the NEXT round's catch-up rewrites before trusting (all are >=
+        the post-round `draft_fed`)."""
+        key_ = ("draft_scan", self.cfg.draft_model, B, F)
+        if key_ not in self._fns:
+            K = F - 2
+            dgen = self.draft_gen
+            use_kernel = self.cfg.use_kernel  # see _mixed_fn: no self
+            shard = self._paged_shard
+            kv_sharding = self._draft_kv_sharding
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def draft_scan(params, toks_in, kv, tables, pos0, n_in):
+                logits, kv = transformer.forward(
+                    dgen.cfg, params, toks_in, pos0, kv=kv, rope=dgen.rope,
+                    moe_impl=dgen._moe_impl, unroll=dgen.scan_unroll,
+                    paged_tables=tables, paged_kernel=use_kernel,
+                    paged_shard=shard,
+                )
+                kv = _pin_kv(kv, kv_sharding)
+                # first proposal: greedy successor of the pending token
+                # (the catch-up row's last REAL position, n_in - 1)
+                idx = jnp.maximum(n_in - 1, 0)
+                first = jnp.argmax(
+                    jnp.take_along_axis(logits, idx[:, None, None], axis=1)
+                    [:, 0, :],
+                    axis=-1,
+                ).astype(jnp.int32)
+
+                def body(carry, _):
+                    tok, kv, pos = carry
+                    lg, kv = transformer.forward(
+                        dgen.cfg, params, tok[:, None], pos, kv=kv,
+                        rope=dgen.rope, moe_impl=dgen._moe_impl,
+                        unroll=dgen.scan_unroll, paged_tables=tables,
+                        paged_kernel=use_kernel, paged_shard=shard,
+                    )
+                    kv = _pin_kv(kv, kv_sharding)  # see _decode_chunk_fn
+                    nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                    return (nxt, kv, pos + 1), nxt
+
+                (_tok, kv, _pos), rest = jax.lax.scan(
+                    body, (first, kv, pos0 + jnp.maximum(n_in, 1)),
+                    jnp.arange(max(K - 1, 0), dtype=jnp.int32),
+                )
+                drafts = jnp.concatenate(
+                    [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+                )
+                return drafts, kv
+
+            self._fns[key_] = draft_scan
+        return self._fns[key_]
+
     def _fetch_blocks_fn(self, W: int):
         """Gather `W` pool blocks into block-LEADING per-leaf arrays —
         the host tier's swap-out/spill snapshot (`HostBlockStore.write`'s
@@ -1023,9 +1335,15 @@ class ServingEngine:
         - ``mixed(max_batch, token_budget)`` always (prefill + decode pack
           into the one unified step);
         - ``verify(max_batch, spec_k + 1)`` when speculative decoding is on
-          (spec_k > 0) — and spec decode FALLS THROUGH to the plain decode
-          path whenever no slot drafts, so the decode entry below stays
-          reachable alongside it;
+          (spec_k > 0) and the verify resolves to exact-match (greedy), or
+          ``verify_sample`` at the same shape when it resolves to the
+          rejection-sampled rule (`ServingConfig.spec_verify_sampled`) —
+          and spec decode FALLS THROUGH to the plain decode path whenever
+          no slot drafts, so the decode entry below stays reachable
+          alongside it;
+        - ``draft_mixed(max_batch, token_budget)`` and
+          ``draft_scan(max_batch, spec_k + 2)`` when a draft model is
+          configured (the mixed-step mirror and the K-token draft scan);
         - ``decode_chunk(max_batch, decode_chunk)`` when decode_chunk > 1,
           else ``decode(max_batch,)``.
 
@@ -1038,7 +1356,14 @@ class ServingEngine:
             ("mixed", (B, self.token_budget))
         ]
         if self.cfg.spec_k:
-            sigs.append(("verify", (B, self.cfg.spec_k + 1)))
+            label = (
+                "verify_sample" if self.cfg.spec_verify_sampled()
+                else "verify"
+            )
+            sigs.append((label, (B, self.cfg.spec_k + 1)))
+            if self.cfg.draft_model:
+                sigs.append(("draft_mixed", (B, self.token_budget)))
+                sigs.append(("draft_scan", (B, self.cfg.spec_k + 2)))
         if self.cfg.decode_chunk > 1:
             sigs.append(("decode_chunk", (B, self.cfg.decode_chunk)))
         else:
@@ -1118,6 +1443,40 @@ class ServingEngine:
                 specs.append(ExecutableSpec(
                     "verify", k, self._verify_fn(B, T), args, None, (2,),
                     dict(roles),
+                ))
+            elif label == "verify_sample":
+                T = k[1]
+                args = (
+                    params, sds((B, T), i32), kv, tables, sds((B,), i32),
+                    sds((B,), i32), key, t_op, p_op,
+                )
+                specs.append(ExecutableSpec(
+                    "verify_sample", k, self._verify_sample_fn(B, T), args,
+                    dict(statics), (2,), dict(roles),
+                ))
+            elif label == "draft_mixed":
+                T = k[1]
+                dparams = abstractify(self._draft_params)
+                dkv = abstractify(self._draft_kv)
+                args = (
+                    dparams, sds((1, T), i32), dkv, tables, sds((1, T), i32),
+                    sds((T,), i32), sds((B,), i32), sds((B,), i32),
+                )
+                specs.append(ExecutableSpec(
+                    "draft_mixed", k, self._draft_mixed_fn(B, T), args,
+                    None, (2,), dict(roles),
+                ))
+            elif label == "draft_scan":
+                F = k[1]
+                dparams = abstractify(self._draft_params)
+                dkv = abstractify(self._draft_kv)
+                args = (
+                    dparams, sds((B, F), i32), dkv, tables, sds((B,), i32),
+                    sds((B,), i32),
+                )
+                specs.append(ExecutableSpec(
+                    "draft_scan", k, self._draft_scan_fn(B, F), args,
+                    None, (2,), dict(roles),
                 ))
             elif label in ("fetch", "restore"):
                 # the host tier's transfer pair moves pool blocks, not
@@ -1303,6 +1662,9 @@ class ServingEngine:
             # with jax's clear deleted-buffer error, not a paged-cache one)
             self._kv = kv
             raise
+        # draft-model lockstep: mirror the packed batch through the draft
+        # pool BEFORE the boundary read below, so the two forwards overlap
+        self._mirror_mixed_to_draft(live, tokens, pos, q_slot, q_start, q_len)
         nxt = np.asarray(nxt)  # mdi-lint: disable=host-sync -- THE unified step's one boundary read: a single sync serves every decode lane and prefill chunk in the batch
         self.stats.mixed_steps += 1
         self.stats.host_syncs += 1
@@ -1354,6 +1716,55 @@ class ServingEngine:
                 n_prefill_toks, time.perf_counter() - t0
             )
         self.stats.prefill_s += time.perf_counter() - t0
+
+    def _mirror_mixed_to_draft(
+        self, live: List[Tuple[SequenceState, int]], tokens: np.ndarray,
+        pos: np.ndarray, q_slot: np.ndarray, q_start: np.ndarray,
+        q_len: np.ndarray,
+    ) -> None:
+        """Feed the mixed step's packed batch through the draft model so
+        its pool tracks the target's feed positions (`draft_fed == fed`
+        lockstep).  Only lanes the draft is actually following get table
+        rows: stale lanes and lanes the draft carve-out cannot cover ride
+        the dispatch writing into the draft trash block and keep the
+        n-gram drafter.  Called between the target dispatch and its
+        boundary read, so the mirror's compute overlaps the sync."""
+        if self.draft_gen is None:
+            return
+        fresh: List[Tuple[SequenceState, int]] = []
+        for seq, n in live:
+            if seq.draft_stale or seq.draft_fed != seq.fed:
+                continue
+            if not self._ensure_draft_blocks(seq, seq.fed + n):
+                # the carve-out cannot follow this lane's prefill; spending
+                # scan catch-up on it later would hit the same wall
+                seq.draft_stale = True
+                continue
+            fresh.append((seq, n))
+        if not fresh:
+            return
+        tables = self._sync_draft_tables([s for s, _ in fresh])
+        B = self.scheduler.max_batch
+        T = self.token_budget
+        fn = self._draft_mixed_fn(B, T)
+        self._introspect(
+            "draft_mixed", (B, T), fn,
+            (self._draft_params, tokens, self._draft_kv, tables, pos,
+             q_slot, q_start, q_len),
+        )
+        kv = self._draft_kv
+        self._draft_kv = None  # donated
+        try:
+            self._draft_kv = fn(
+                self._draft_params, jnp.asarray(tokens), kv,
+                jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(q_slot),
+                jnp.asarray(q_start), jnp.asarray(q_len),
+            )
+        except Exception:
+            self._draft_kv = kv  # see _run_mixed: keep failures diagnosable
+            raise
+        for seq, n in fresh:
+            seq.draft_fed += n
 
     def _queue_depth(self) -> int:
         return len(self.scheduler.waiting) + len(self.scheduler.preempted)
@@ -1613,20 +2024,94 @@ class ServingEngine:
 
     # -- batched speculative decode (ragged verify over the paged cache) ------
 
+    def _draft_ready(self, seq: SequenceState) -> bool:
+        """May this lane use the model drafter this round?  Requires
+        fresh draft KV (a catch-up gap the K+2-wide scan can absorb) and
+        draft-pool coverage for every scan write (positions through
+        `fed + spec_k + 1`).  A lane that fell past the absorbable gap
+        (chunked-fallback rounds advanced the target without the draft)
+        goes permanently stale — the documented quality concession: it
+        keeps the n-gram drafter rather than paying a re-prefill."""
+        if self.draft_gen is None or seq.draft_stale:
+            return False
+        gap = seq.fed - seq.draft_fed
+        if not 0 <= gap <= self.cfg.spec_k + 1:
+            seq.draft_stale = True
+            return False
+        return self._ensure_draft_blocks(seq, seq.fed + self.cfg.spec_k + 2)
+
+    def _run_draft_scan(
+        self, seqs: List[SequenceState], K: int,
+    ) -> List[Tuple[SequenceState, List[int]]]:
+        """ONE jitted draft-model dispatch proposing K greedy tokens per
+        lane (`_draft_scan_fn`: ragged catch-up + K-1 step scan).  Costs
+        one extra host read, paid only on rounds where some lane actually
+        uses the model drafter; n-gram-hit rounds never dispatch it."""
+        t0 = time.perf_counter()
+        B = self.scheduler.max_batch
+        F = K + 2
+        toks_in = np.zeros((B, F), np.int32)
+        pos0 = np.zeros((B,), np.int32)
+        n_in = np.zeros((B,), np.int32)
+        for seq in seqs:
+            # decode-phase invariant: tokens[fed] IS the pending token, so
+            # the catch-up feed is every token the draft has not seen yet
+            feed = seq.tokens[seq.draft_fed : seq.fed + 1]
+            toks_in[seq.slot, : len(feed)] = feed
+            pos0[seq.slot] = seq.draft_fed
+            n_in[seq.slot] = len(feed)
+        tables = self._sync_draft_tables(seqs)
+        fn = self._draft_scan_fn(B, F)
+        self._introspect(
+            "draft_scan", (B, F), fn,
+            (self._draft_params, toks_in, self._draft_kv, tables, pos0,
+             n_in),
+        )
+        kv = self._draft_kv
+        self._draft_kv = None  # donated
+        try:
+            d, self._draft_kv = fn(
+                self._draft_params, jnp.asarray(toks_in), kv,
+                jnp.asarray(tables), jnp.asarray(pos0), jnp.asarray(n_in),
+            )
+        except Exception:
+            self._draft_kv = kv  # see _run_mixed: keep failures diagnosable
+            raise
+        d = np.asarray(d)  # mdi-lint: disable=host-sync -- the draft proposals feed the verify batch built host-side; only model-draft rounds pay this read
+        self.stats.host_syncs += 1
+        # the scan's F-wide feed plus K-1 single steps, all draft-model
+        # positions; useful credit rides the verify's accepted tokens
+        self.stats.observe_dispatch(B * F + B * max(K - 1, 0), 0)
+        if self.obs is not None:
+            self.obs.step(
+                "draft_scan", width=B * F, live=len(seqs), t_start=t0,
+                kv_utilization=self.pool.utilization,
+                queue_depth=self._queue_depth(), spec_k=K,
+            )
+        return [(seq, [int(t) for t in d[seq.slot]]) for seq in seqs]
+
     def _run_spec_decode(self, seqs: List[SequenceState]) -> bool:
-        """Batched speculative serving step: draft up to `spec_k` tokens per
-        slot by prompt-lookup (`ngram_draft` over prompt + generation, the
-        machinery `generate()`'s B=1 fast path uses), score every slot's
-        [pending] + draft in ONE ragged verify forward over the paged
-        cache, and emit each slot's accepted prefix + bonus token.  Returns
-        False when NO slot drafted — the caller falls back to a plain
-        chunked burst (a (K+1)-wide verify would burn (K+1)x the step cost
-        to emit one token per slot)."""
+        """Batched speculative serving step: draft up to `spec_k` tokens
+        per slot — prompt-lookup first (`ngram_draft`, the machinery
+        `generate()`'s B=1 fast path uses), the optional draft model where
+        the lookup misses — score every slot's [pending] + draft in ONE
+        ragged verify forward over the paged cache, and emit each slot's
+        accepted prefix + bonus/resampled token.  The verify rule follows
+        `ServingConfig.spec_verify_sampled()`: exact-match against greedy
+        successors at temperature 0 (bit-identical streams, the historical
+        path), the rejection-sampled accept/resample of `ops/sampling.
+        speculative_verify` at temperature>0 (distribution-preserving).
+        Returns False when NO slot drafted — the caller falls back to a
+        plain chunked burst (a (K+1)-wide verify would burn (K+1)x the
+        step cost to emit one token per slot)."""
         K = self.cfg.spec_k
+        sampled = self.cfg.spec_verify_sampled()
         candidates = [
             s for s in seqs if self.scheduler.slots[s.slot] is s
         ]
         drafts: Dict[int, List[int]] = {}
+        source: Dict[int, str] = {}
+        model_lanes: List[SequenceState] = []
         for seq in candidates:
             # draft only with window room for all K+1 writes and at least
             # 2 tokens of budget left (a 1-token tail gains nothing); cap
@@ -1640,6 +2125,16 @@ class ServingEngine:
                 d = ngram_draft(seq.tokens, K)[: remaining - 1]
                 if d:
                     drafts[seq.slot] = [int(t) for t in d]
+                    source[seq.slot] = "ngram"
+                elif self._draft_ready(seq):
+                    model_lanes.append(seq)
+        if model_lanes:
+            for seq, d in self._run_draft_scan(model_lanes, K):
+                remaining = seq.req.max_new_tokens - seq.n_generated
+                d = d[: remaining - 1]
+                if d:
+                    drafts[seq.slot] = d
+                    source[seq.slot] = "model"
         if not drafts:
             return False
         t0 = time.perf_counter()
@@ -1652,34 +2147,78 @@ class ServingEngine:
         B = self.scheduler.max_batch
         toks_in = np.zeros((B, K + 1), np.int32)
         pos = np.zeros((B,), np.int32)
+        dlen = np.zeros((B,), np.int32)
+        fed0 = {id(s): s.fed for s in live}
         for seq in live:
             row = [int(seq.next_tok)] + pad_draft(drafts.get(seq.slot, []), K)
             toks_in[seq.slot] = row
             pos[seq.slot] = seq.fed
+            dlen[seq.slot] = len(drafts.get(seq.slot, ()))
         tables = self._sync_tables(live)
-        fn = self._verify_fn(B, K + 1)
-        self._introspect(
-            "verify", (B, K + 1), fn,
-            (self._params, toks_in, self._kv, tables, pos),
-        )
-        kv = self._kv
-        self._kv = None  # donated
-        try:
-            g, self._kv = fn(
-                self._params, jnp.asarray(toks_in), kv,
-                jnp.asarray(tables), jnp.asarray(pos),
+        if sampled:
+            fn = self._verify_sample_fn(B, K + 1)
+            self._introspect(
+                "verify_sample", (B, K + 1), fn,
+                (self._params, toks_in, self._kv, tables, pos, dlen,
+                 self.gen.key, self._t_op, self._p_op),
+                {"mode": self._sample_mode, "top_k": self.cfg.top_k},
             )
-        except Exception:
-            self._kv = kv  # see _run_mixed: keep failures diagnosable
-            raise
-        g = np.asarray(g)
+            kv = self._kv
+            self._kv = None  # donated
+            try:
+                out, n_emit, self._kv, self.gen.key = fn(
+                    self._params, jnp.asarray(toks_in), kv,
+                    jnp.asarray(tables), jnp.asarray(pos),
+                    jnp.asarray(dlen), self.gen.key, self._t_op,
+                    self._p_op,
+                    mode=self._sample_mode, top_k=self.cfg.top_k,
+                )
+            except Exception:
+                self._kv = kv  # see _run_mixed: keep failures diagnosable
+                raise
+            out = np.asarray(out)  # mdi-lint: disable=host-sync -- the verify boundary read (tokens + per-slot emit counts in one sync)
+            n_emit = np.asarray(n_emit)
+            bursts = {
+                seq.slot: [
+                    int(t) for t in out[seq.slot, : int(n_emit[seq.slot])]
+                ]
+                for seq in live
+            }
+        else:
+            fn = self._verify_fn(B, K + 1)
+            self._introspect(
+                "verify", (B, K + 1), fn,
+                (self._params, toks_in, self._kv, tables, pos),
+            )
+            kv = self._kv
+            self._kv = None  # donated
+            try:
+                g, self._kv = fn(
+                    self._params, jnp.asarray(toks_in), kv,
+                    jnp.asarray(tables), jnp.asarray(pos),
+                )
+            except Exception:
+                self._kv = kv  # see _run_mixed: keep failures diagnosable
+                raise
+            g = np.asarray(g)
+            # accept only over the REAL draft length: a 0-padded row must
+            # not luck into matching the model's 0-token successor
+            bursts = {
+                seq.slot: accept_draft(
+                    pad_draft(drafts.get(seq.slot, []), K), g[seq.slot],
+                    len(drafts.get(seq.slot, ())),
+                )
+                for seq in live
+            }
         self.stats.decode_steps += 1
         self.stats.host_syncs += 1
+        accepted_total = sum(len(b) - 1 for b in bursts.values())
         if self.obs is not None:
             self.obs.step(
                 "verify", width=B * (K + 1), live=len(live), t_start=t0,
                 kv_utilization=self.pool.utilization,
                 queue_depth=self._queue_depth(),
+                spec_k=K, accepted=accepted_total,
             )
         # useful side credited below per slot as len(burst) — the pending
         # row plus ACCEPTED draft rows; rejected draft rows are padding
@@ -1689,19 +2228,102 @@ class ServingEngine:
         self.stats.observe_resident(len(self.scheduler.running()))
         for seq in live:
             d = drafts.get(seq.slot, [])
-            # accept only over the REAL draft length: a 0-padded row must
-            # not luck into matching the model's 0-token successor
-            burst = accept_draft(pad_draft(d, K), g[seq.slot], len(d))
+            burst = bursts[seq.slot]
+            src = source.get(seq.slot)
+            accepted = len(burst) - 1
             self.stats.spec_drafted += len(d)
-            self.stats.spec_accepted += len(burst) - 1
+            self.stats.spec_accepted += accepted
+            if src == "model":
+                self.stats.spec_drafted_model += len(d)
+                self.stats.spec_accepted_model += accepted
+            elif src == "ngram":
+                self.stats.spec_drafted_ngram += len(d)
+                self.stats.spec_accepted_ngram += accepted
+            if self.obs is not None and src is not None:
+                self.obs.spec(len(d), accepted, src)
             self.stats.tokens_useful += len(burst)
             for t in burst:
                 seq.fed += 1
                 self._emit(seq, int(t))
                 if seq.done:
                     break
+            if src == "model" and not seq.done:
+                # the scan wrote draft KV for proposals d_1..d_{K-1}; the
+                # accepted prefix of those is now real sequence — the next
+                # catch-up resumes right after it
+                seq.draft_fed = fed0[id(seq)] + 1 + min(accepted, K - 1)
         self.stats.decode_s += time.perf_counter() - t0
         return True
+
+    def prime(self) -> None:
+        """Dispatch the conditionally-reached speculative executables once
+        with inert operands so they compile at WARMUP time.  The
+        mixed/decode executables compile on any real warmup trace, but a
+        verify only fires when a draft actually HITS and the draft scan
+        only on an n-gram miss — workload-dependent events a short warmup
+        trace may never produce, leaving the executable cold and its first
+        mid-serve hit compiling inside the timed region (the
+        zero-post-warmup-recompile contract).  Every table row points at
+        block 0 (the reserved trash block), so the donated pool writes are
+        discarded by construction and no live sequence state changes; the
+        jit cache is per-Generator, so priming one engine warms every
+        engine sharing its `gen`."""
+        K = self.cfg.spec_k
+        if not K:
+            return
+        B = self.scheduler.max_batch
+        tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
+        toks = np.zeros((B, K + 1), np.int32)
+        zB = np.zeros((B,), np.int32)
+        kv = self._kv
+        self._kv = None  # donated
+        try:
+            if self.cfg.spec_verify_sampled():
+                fn = self._verify_sample_fn(B, K + 1)
+                _, _, self._kv, self.gen.key = fn(
+                    self._params, jnp.asarray(toks), kv,
+                    jnp.asarray(tables), jnp.asarray(zB), jnp.asarray(zB),
+                    self.gen.key, self._t_op, self._p_op,
+                    mode=self._sample_mode, top_k=self.cfg.top_k,
+                )
+            else:
+                fn = self._verify_fn(B, K + 1)
+                _, self._kv = fn(
+                    self._params, jnp.asarray(toks), kv,
+                    jnp.asarray(tables), jnp.asarray(zB),
+                )
+        except Exception:
+            self._kv = kv  # see _run_mixed: keep failures diagnosable
+            raise
+        if self.draft_gen is None:
+            return
+        dtables = np.zeros_like(self._draft_tables)
+        dkv = self._draft_kv
+        self._draft_kv = None  # donated
+        try:
+            _, self._draft_kv = self._draft_scan_fn(B, K + 2)(
+                self._draft_params, jnp.zeros((B, K + 2), jnp.int32),
+                dkv, jnp.asarray(dtables), jnp.asarray(zB),
+                jnp.asarray(zB),
+            )
+        except Exception:
+            self._draft_kv = dkv
+            raise
+        T = self.token_budget
+        trash_pos = self.max_blocks_per_seq * self.pool.block_size
+        dkv = self._draft_kv
+        self._draft_kv = None  # donated
+        try:
+            self._draft_kv = self._draft_mixed_fn(B, T)(
+                self._draft_params, jnp.zeros((1, T), jnp.int32), dkv,
+                jnp.asarray(dtables),
+                jnp.full((1, T), trash_pos, jnp.int32),
+                jnp.zeros((T,), jnp.int32), jnp.asarray(zB),
+                jnp.asarray(zB),
+            )
+        except Exception:
+            self._draft_kv = dkv
+            raise
 
     def step(self) -> bool:  # mdi-thread: engine
         """Run one scheduler action; False when nothing was runnable.

@@ -176,6 +176,13 @@ class PipelinedServingEngine(ServingEngine):
     _kv_block_axis = 2
 
     def __init__(self, gen, serving: ServingConfig, obs=None, policy=None):
+        if serving.draft_model:
+            raise ValueError(
+                "draft_model is not supported by the pipeline-parallel "
+                "engine: the draft pool has no per-stage ring — serve "
+                "the draft-model config on a tp-only (or single-device) "
+                "mesh"
+            )
         mesh = gen.mesh
         if mesh is None or int(dict(mesh.shape).get("pp", 1)) <= 1:
             raise ValueError(

@@ -82,6 +82,16 @@ class SequenceState:
             self.next_tok = self.resume_tok
         self.done = False
         self.admit_order = -1  # stamped by the scheduler at admission
+        # draft-model bookkeeping (engine-owned; inert without a draft pool):
+        # `draft_fed` counts tokens whose K/V the DRAFT model has seen,
+        # `draft_blocks` is the sequence's table into the draft pool, and
+        # `draft_stale` marks sequences the draft can never catch up on —
+        # prefix-cache hits and swap restores hand the TARGET pool KV the
+        # draft was never fed (a documented quality concession: those lanes
+        # keep the n-gram drafter, never the model drafter).
+        self.draft_fed = 0
+        self.draft_blocks: List[int] = []
+        self.draft_stale = n_cached > 0
 
     @property
     def n_prompt(self) -> int:
@@ -142,6 +152,11 @@ class Scheduler:
         self.swap_drop_hook: Optional[Callable[[object], None]] = None
         self.swaps_out = 0  # preemptions resolved by swap, not recompute
         self.swaps_in = 0  # admissions resumed from host-tier payloads
+        # draft-model KV pool (serving/engine.py installs it when
+        # ServingConfig.draft_model is set): the scheduler only RELEASES
+        # draft blocks on retire/preempt so the two pools' lifetimes stay
+        # in lockstep; allocation is engine-side (non-preempting).
+        self.draft_pool: Optional[KVPool] = None
         # observability hook (obs.ServingObserver or None): the scheduler
         # owns the request lifecycle edges — submitted/admitted/resumed/
         # preempted/retired — so it reports them; all hooks are plain
@@ -309,10 +324,20 @@ class Scheduler:
         self.slots[seq.slot] = None
         self.pool.release(seq.blocks)
         seq.blocks = []
+        self._release_draft(seq)
         self.finished.append(seq)
         self.policy.on_retired(seq)  # fair-share usage accounting
         if self.observer is not None:
             self.observer.request_finished(seq.req.rid)
+
+    def _release_draft(self, seq: SequenceState) -> None:
+        """Return a sequence's draft-pool blocks (no-op without a draft
+        pool).  Draft KV is always recomputable from the token list, so
+        retire and preempt both drop it wholesale."""
+        if self.draft_pool is not None and seq.draft_blocks:
+            self.draft_pool.release(seq.draft_blocks)
+        seq.draft_blocks = []
+        seq.draft_fed = 0
 
     def preempt_latest(self, exclude: Optional[SequenceState] = None) -> bool:  # mdi-thread: engine
         """Recompute-style preemption: kick the lowest-priority lane back
@@ -342,6 +367,7 @@ class Scheduler:
         self.slots[seq.slot] = None
         self.pool.release(seq.blocks)
         seq.blocks = []
+        self._release_draft(seq)
         # resume from the full token list; the pending token rides along
         toks = list(seq.tokens)
         if seq.next_tok is not None and (not toks or toks[-1] != seq.next_tok):

@@ -268,13 +268,20 @@ def test_serving_chunk_headroom_budgeted():
     assert "bad-serving-config" not in codes(r)
     pool = r.breakdown["kv_pool"]
     assert pool["decode_chunk"] == 16 and pool["reserve_headroom_blocks"] == 9
-    # speculative serving is greedy-only: the auditor flags it statically
+    # temperature>0 + spec is legal now (rejection-sampled verify); only
+    # the pinned exact-match path (spec_sampled=False) is refused
     r = audit_plan(PlanSpec(
         cfg=tiny(),
         serving=ServingConfig(block_size=4, spec_k=4, temperature=0.8),
     ))
+    assert "bad-serving-config" not in codes(r)
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=4, spec_k=4, temperature=0.8,
+                              spec_sampled=False),
+    ))
     assert "bad-serving-config" in codes(r)
-    assert any("greedy" in f.message for f in r.findings)
+    assert any("spec_sampled" in f.message for f in r.findings)
 
 
 def test_bad_token_budget_rejected():
@@ -387,6 +394,72 @@ def test_pool_estimate_byte_exact_vs_live_engine_with_chunk_reservations():
     live = sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(engine._kv))
     assert r.breakdown["kv_pool"]["pool_bytes"] == live
     assert r.breakdown["kv_pool"]["num_blocks"] == engine.pool.num_blocks
+
+
+def test_draft_pool_estimate_byte_exact_vs_live_engine():
+    """The `draft_*` kv_pool breakdown must equal the live draft pool's
+    allocated bytes exactly — `num_draft_blocks`/`draft_pool_bytes` are
+    the same formulas `_init_draft` allocates from, so the estimator and
+    the engine can never disagree on the carve-out."""
+    import jax
+
+    from mdi_llm_tpu.generation import Generator
+    from mdi_llm_tpu.models import transformer
+
+    cfg = tiny()
+    sv = ServingConfig(block_size=8, max_batch=2, decode_chunk=4, spec_k=4,
+                       draft_model="pythia-14m", draft_share=0.25)
+    seq_len = 64
+    r = audit_plan(PlanSpec(cfg=cfg, serving=sv, max_seq_length=seq_len,
+                            cache_dtype="float32"))
+    assert codes(r) == []
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    engine = Generator(
+        cfg, params, max_seq_length=seq_len, cache_dtype="float32"
+    ).serve(serving=sv)
+    live = sum(int(x.nbytes)
+               for x in jax.tree_util.tree_leaves(engine._draft_kv))
+    kvp = r.breakdown["kv_pool"]
+    assert kvp["draft_pool_bytes"] == live
+    assert kvp["draft_num_blocks"] == engine.draft_pool.num_blocks
+    assert kvp["draft_model"] == "pythia-14m"
+
+
+def test_draft_serving_config_walls():
+    """Static refusals around the draft-model knob: a draft without
+    spec_k, a vocab-mismatched draft, and a draft_share that starves the
+    target pool below one slot's chunk-reservation headroom."""
+    # draft with nothing to draft for
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=8, draft_model="pythia-14m"),
+    ))
+    assert "bad-serving-config" in codes(r)
+    assert any("spec_k" in f.message for f in r.findings)
+    # vocab mismatch: pythia vs llama tokenizers
+    r = audit_plan(PlanSpec(
+        cfg=Config.from_name("tiny-llama-1.1b"),
+        serving=ServingConfig(block_size=8, spec_k=4,
+                              draft_model="pythia-14m"),
+    ))
+    assert "bad-serving-config" in codes(r)
+    assert any("vocab" in f.message for f in r.findings)
+    # carve-out starves the target: max_blocks=6 at share 0.5 leaves the
+    # target below headroom+1 usable blocks
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=8, spec_k=4, max_blocks=6,
+                              draft_model="pythia-14m", draft_share=0.5),
+    ))
+    assert "bad-serving-config" in codes(r)
+    assert any("draft_share" in f.message for f in r.findings)
+    # an unknown draft name is a finding, not a crash
+    r = audit_plan(PlanSpec(
+        cfg=tiny(),
+        serving=ServingConfig(block_size=8, spec_k=4,
+                              draft_model="no-such-model"),
+    ))
+    assert "bad-serving-config" in codes(r)
 
 
 def test_findings_reuse_lint_baseline_machinery():

@@ -527,6 +527,21 @@ def test_serve_open_flags_in_help():
     assert "serve-open" in help_text
 
 
+def test_sampled_spec_flags_in_help_and_suite_row():
+    """The rejection-sampled speculative knobs are documented on bench
+    --help and the suite carries the spec-vs-sampling head-to-head rung
+    (same seed, per-step sampling fallback in the ladder)."""
+    help_text = bench.build_parser().format_help()
+    for flag in ("--spec-k", "--temperature", "--top-k", "--top-p",
+                 "--draft-model"):
+        assert flag in help_text, f"{flag} missing from bench --help"
+    rows = {r["name"]: r for r in bench.SUITE_ROWS}
+    spec = rows["serving-cb-spec"]
+    assert "--spec-k" in spec["flags"] and "--temperature" in spec["flags"]
+    # the ladder degrades to plain sampled serving, never drops the row
+    assert ["--spec-k", "0", "--temperature", "0.7"] in spec["ladder"]
+
+
 def test_no_hardware_skips_probe_and_banks_serving_fallbacks(monkeypatch):
     """The r6 wedge fix: with no host-local TPU evidence the suite never
     probes (libtpu's metadata retry storm burned the whole r03–r05 probe

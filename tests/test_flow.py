@@ -322,6 +322,29 @@ def test_committed_goldens_match_the_current_compile_set():
     )
 
 
+def test_committed_goldens_cover_spec_draft_compile_set():
+    """The sampled-speculative + draft-model serving set has committed
+    budgets too (origin `@spec4@draft`): rejection verify, draft mirror
+    and draft catch-up scan — regenerate with `mdi-flow --model pythia-14m
+    --spec-k 4 --temperature 0.8 --draft-model pythia-14m
+    --update-goldens` on deliberate churn."""
+    goldens = load_goldens(REPO / "goldens" / "flow-goldens.json")
+    engine = trace_serving(
+        Config.from_name(MODEL),
+        ServingConfig(spec_k=4, temperature=0.8, draft_model=MODEL),
+    )
+    origin = f"{MODEL}@spec4@draft"
+    _, profiles = analyze_flow(engine.enumerate_executables(),
+                               origin=origin)
+    labels = {p.name.split("(")[0] for p in profiles}
+    assert {"verify_sample", "draft_scan", "draft_mixed"} <= labels
+    findings = _check_goldens(profiles, goldens, origin)
+    assert findings == [], "\n".join(f.message for f in findings)
+    assert {f"{origin}::{p.name}" for p in profiles} <= set(
+        goldens["budgets"]
+    )
+
+
 # ---------------------------------------------------------------------------
 # preflight gate + detail record (bench.py / mdi-serve wiring)
 # ---------------------------------------------------------------------------

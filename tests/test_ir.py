@@ -186,6 +186,16 @@ def test_trace_failure_is_a_finding_not_a_crash():
         (dict(decode_chunk=1), {"mixed", "decode"}),
         (dict(spec_k=3), {"mixed", "decode_chunk", "verify"}),
         (dict(spec_k=3, decode_chunk=1), {"mixed", "decode", "verify"}),
+        # temperature>0 routes spec through the rejection-sampled verify
+        (dict(spec_k=3, temperature=0.8),
+         {"mixed", "decode_chunk", "verify_sample"}),
+        # a draft model adds its mirror/catch-up scan executables
+        (dict(spec_k=3, draft_model="pythia-14m"),
+         {"mixed", "decode_chunk", "verify", "draft_mixed", "draft_scan"}),
+        (dict(spec_k=3, temperature=0.8, top_p=0.95,
+              draft_model="pythia-14m"),
+         {"mixed", "decode_chunk", "verify_sample", "draft_mixed",
+          "draft_scan"}),
     ],
 )
 def test_enumeration_covers_every_step_dispatch_path(serving, expect):

@@ -220,6 +220,56 @@ def test_preemption_and_resume_recorded():
     assert m["serving_requests_resumed_total"] == 1
 
 
+def test_spec_counters_split_by_source_and_accept_rate_gauge():
+    """`ServingObserver.spec` keeps per-source (ngram vs model) drafted/
+    accepted counters, the totals, and the lifetime accept-rate gauge."""
+    obs = ServingObserver(ring=64, clock=FakeClock())
+    obs.spec(4, 3, "ngram")
+    obs.spec(4, 1, "model")
+    obs.spec(2, 2, "ngram")
+    d = obs.metrics.to_dict()
+    c = d["counters"]
+    assert c["serving_spec_drafted_ngram_total"] == 6
+    assert c["serving_spec_accepted_ngram_total"] == 5
+    assert c["serving_spec_drafted_model_total"] == 4
+    assert c["serving_spec_accepted_model_total"] == 1
+    assert c["serving_spec_drafted_total"] == 10
+    assert c["serving_spec_accepted_total"] == 6
+    assert d["gauges"]["serving_spec_accept_rate"] == pytest.approx(0.6)
+
+
+def test_verify_spans_and_spec_counters_on_live_engine(served_model):
+    """On a real speculative run the observer's spec counters equal the
+    engine's aggregate stats, and every Perfetto verify span records
+    spec_k and the accepted count for that round."""
+    cfg, params = served_model
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, cfg.vocab_size, 5).tolist()  # cycling prompt
+    obs = ServingObserver(ring=4096)
+    engine = Generator(cfg, params, cache_dtype=jnp.float32).serve(
+        block_size=4, max_batch=2, decode_chunk=4, spec_k=4, obs=obs,
+    )
+    engine.add_request("r0", prompt, 20)
+    _, stats = engine.run()
+    assert stats.spec_drafted > 0 and stats.spec_accepted > 0
+    c = obs.metrics.to_dict()["counters"]
+    assert c["serving_spec_drafted_total"] == stats.spec_drafted
+    assert c["serving_spec_accepted_total"] == stats.spec_accepted
+    assert c["serving_spec_drafted_ngram_total"] == stats.spec_drafted_ngram
+    g = obs.metrics.to_dict()["gauges"]
+    assert g["serving_spec_accept_rate"] == pytest.approx(
+        stats.spec_accept_rate)
+    spans = [e for e in obs.tracer.events
+             if e["name"] == "verify" and e.get("ph") != "M"]
+    assert spans, "speculative run produced no verify spans"
+    accepted = 0
+    for e in spans:
+        args = e.get("args") or {}
+        assert args.get("spec_k") == 4
+        accepted += int(args.get("accepted", 0))
+    assert accepted == stats.spec_accepted
+
+
 # ---------------------------------------------------------------------------
 # ring bounding
 # ---------------------------------------------------------------------------

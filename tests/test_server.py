@@ -361,6 +361,12 @@ def test_server_cli_help_covers_new_flags():
     assert "--policy" in serve_help
     for policy in ("fcfs", "priority", "fair", "deadline"):
         assert policy in serve_help
+    # rejection-sampled speculative knobs surface on BOTH front-ends
+    # (mdi-server inherits serve's parser)
+    for flag in ("--spec-k", "--temperature", "--top-k", "--top-p",
+                 "--draft-model"):
+        assert flag in serve_help, f"{flag} missing from mdi-serve --help"
+        assert flag in server_help, f"{flag} missing from mdi-server --help"
 
 
 def test_server_console_script_registered():

@@ -576,13 +576,185 @@ def test_spec_draft_capped_by_budget_on_tight_pool(served_model):
     assert engine.scheduler.preemptions == 0  # fit without self-preempting
 
 
-def test_speculative_requires_greedy(served_model):
+def test_spec_config_gates(served_model):
+    """temperature>0 + spec_k is legal (rejection verify); the refusal now
+    guards only the pinned exact-match path and the draft-model knob."""
     cfg, params = served_model
     gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    # spec_sampled=False pins the exact-match verify: greedy-only.
     with pytest.raises(ValueError, match="temperature"):
-        gen.serve(spec_k=4, temperature=0.7)
+        gen.serve(spec_k=4, temperature=0.7, spec_sampled=False)
+    # default (spec_sampled=None) auto-selects the rejection verify.
+    engine = gen.serve(spec_k=4, temperature=0.7)
+    assert engine.cfg.spec_verify_sampled()
+    # a draft model without spec_k has nothing to draft for
+    with pytest.raises(ValueError, match="spec_k"):
+        gen.serve(draft_model="test-tiny")
     with pytest.raises(ValueError, match="decode_chunk"):
         gen.serve(decode_chunk=0)
+
+
+# ---------------------------------------------------------------------------
+# Rejection-sampled speculative decoding (temperature > 0)
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_verify_greedy_is_exact_match():
+    """mode='greedy' keeps the old contract exactly: accept the longest
+    prefix matching the argmax successors, emit the argmax bonus."""
+    from mdi_llm_tpu.ops.sampling import sampling_operands, speculative_verify
+
+    rng = np.random.default_rng(0)
+    B, K, V = 2, 3, 16
+    logits = jnp.asarray(rng.normal(size=(B, K + 1, V)), jnp.float32)
+    g = np.argmax(np.asarray(logits), axis=-1)
+    draft = np.stack([
+        [g[0, 0], g[0, 1], (g[0, 2] + 1) % V],   # matches 2, diverges at 2
+        [(g[1, 0] + 1) % V, g[1, 1], g[1, 2]],   # diverges immediately
+    ]).astype(np.int32)
+    t_op, p_op = sampling_operands(0.0, None)
+    out, n = speculative_verify(
+        logits, jnp.asarray(draft), jnp.asarray([3, 3], jnp.int32),
+        jax.random.PRNGKey(0), t_op, p_op, mode="greedy",
+    )
+    out, n = np.asarray(out), np.asarray(n)
+    assert list(n) == [3, 1]
+    np.testing.assert_array_equal(out[0, :3], g[0, :3])
+    assert out[1, 0] == g[1, 0]
+
+
+@pytest.mark.parametrize("mode,top_k,top_p", [
+    ("top_k", None, None),   # plain temperature
+    ("top_k", 4, None),      # top-k filter
+    ("top_p", None, 0.9),    # nucleus filter
+])
+def test_speculative_verify_preserves_distribution(mode, top_k, top_p):
+    """The tentpole's statistical acceptance pin: at every position the
+    verify reaches, the emitted token is marginally a draw from the SAME
+    filtered softmax the per-step sampler uses — accepted draft or
+    resampled residual, the total law is p (Leviathan/Chen rejection rule
+    with a one-hot draft distribution)."""
+    from mdi_llm_tpu.ops.sampling import (
+        filtered_logits, sampling_operands, speculative_verify)
+
+    rng = np.random.default_rng(42)
+    K, V, N = 2, 8, 10000
+    logits = jnp.asarray(rng.normal(size=(1, K + 1, V)) * 1.5, jnp.float32)
+    # draft each position's argmax so later positions are reached often
+    draft = jnp.argmax(logits[:, :K, :], axis=-1).astype(jnp.int32)
+    dlen = jnp.asarray([K], jnp.int32)
+    t_op, p_op = sampling_operands(0.7, top_p)
+
+    def one(key):
+        return speculative_verify(logits, draft, dlen, key, t_op, p_op,
+                                  mode=mode, top_k=top_k)
+
+    keys = jax.random.split(jax.random.PRNGKey(7), N)
+    outs, nems = jax.jit(jax.vmap(one))(keys)
+    outs = np.asarray(outs)[:, 0, :]
+    nems = np.asarray(nems)[:, 0]
+    f = np.asarray(filtered_logits(logits, t_op, p_op,
+                                   mode=mode, top_k=top_k))[0]
+    p = np.exp(f - f.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    for j in range(K + 1):
+        reach = nems > j
+        n_j = int(reach.sum())
+        assert n_j > N // 20, f"position {j} starved ({n_j} trials)"
+        freq = np.bincount(outs[reach, j], minlength=V) / n_j
+        se = np.sqrt(p[j] * (1.0 - p[j]) / n_j)
+        assert np.all(np.abs(freq - p[j]) < 5.0 * se + 1e-3), (
+            f"pos {j}: emitted law diverges from the filtered softmax\n"
+            f"freq={freq}\np   ={p[j]}"
+        )
+        # filtered-out tokens must never be emitted
+        assert np.all(freq[p[j] == 0.0] == 0.0)
+
+
+@pytest.fixture(scope="module")
+def spec_greedy_ref(served_model):
+    """One shared greedy reference for the sampled-spec tests: cycling
+    prompts (so drafting genuinely fires) and their sequential streams."""
+    cfg, params = served_model
+    prompts = _cycling_prompts(cfg, (5, 7, 0))
+    max_news = [24, 20, 16]
+    return prompts, max_news, _sequential_greedy(cfg, params, prompts,
+                                                 max_news)
+
+
+def test_sampled_spec_identity_and_zero_recompiles(served_model,
+                                                   spec_greedy_ref):
+    """Two acceptance pins in one warm/timed pair: (1) temperature>0 with
+    top_k=1 makes every filtered distribution one-hot, so the rejection
+    verify must reproduce the greedy stream bit-for-bit while drafting
+    and accepting; (2) temperature stays a traced operand through the
+    verify, so the post-warmup temperature sweep builds no new executable
+    (`prime()` dispatches the draft-hit-gated verify at warmup)."""
+    from mdi_llm_tpu.utils.profiling import CompileGuard
+
+    cfg, params = served_model
+    prompts, max_news, want = spec_greedy_ref
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    knobs = dict(block_size=4, max_batch=3, decode_chunk=4, spec_k=4,
+                 top_k=1)
+    guard = CompileGuard(label="spec-temp-sweep")
+    with guard:
+        engine = gen.serve(temperature=0.7, **knobs)
+        assert engine.cfg.spec_verify_sampled()
+        engine.prime()
+        for i, (p, m) in enumerate(zip(prompts, max_news)):
+            engine.add_request(f"r{i}", p, m)
+        results, stats = engine.run()
+        guard.mark_warm()
+        for t in (0.5, 1.3):
+            e2 = gen.serve(temperature=t, **knobs)
+            for i, p in enumerate(prompts):
+                e2.add_request(f"s{i}", p, 10)
+            e2.run()
+    assert guard.traces_after_warmup == 0
+    assert guard.backend_compiles_after_warmup == 0
+    guard.expect_clean()
+    for i in range(len(prompts)):
+        assert results[f"r{i}"] == want[i], f"r{i} diverged under sampled verify"
+    assert stats.spec_drafted > 0 and stats.spec_accepted > 0
+    assert engine.pool.used == 0
+
+
+def test_draft_model_serving_token_identical(served_model, spec_greedy_ref):
+    """The optional draft model mirrors the target's paged layout in its
+    own carved-out pool.  Greedy spec with model drafts stays exactly the
+    sequential greedy stream; the sampled verify at top_k=1 (one-hot
+    distributions) reproduces it bit-for-bit too, splitting the drafted
+    counters by source — and both pools drain after every run."""
+    cfg, params = served_model
+    dcfg = tiny_config(name="test-tiny-draft", n_layer=1,
+                       block_size=cfg.block_size)
+    dparams = init_params(dcfg, jax.random.PRNGKey(1))
+    prompts, max_news, want = spec_greedy_ref
+    gen = Generator(cfg, params, cache_dtype=jnp.float32)
+    dgen = Generator(dcfg, dparams, cache_dtype=jnp.float32)
+    # the sampled pass replays a greedy-PREFIX workload (deterministic
+    # argmax streams truncate cleanly), halving its runtime
+    sampled_news = [m // 2 for m in max_news]
+    for sampling, news in ((dict(), max_news),
+                           (dict(temperature=0.7, top_k=1), sampled_news)):
+        engine = gen.serve(block_size=4, max_batch=3, decode_chunk=4,
+                           spec_k=4, draft_model="test-tiny-draft",
+                           draft_gen=dgen, **sampling)
+        assert engine.cfg.spec_verify_sampled() == bool(sampling)
+        for i, (p, m) in enumerate(zip(prompts, news)):
+            engine.add_request(f"r{i}", p, m)
+        results, stats = engine.run()
+        for i, m in enumerate(news):
+            assert results[f"r{i}"] == want[i][:len(prompts[i]) + m], \
+                f"r{i} diverged with draft model ({sampling or 'greedy'})"
+        assert stats.spec_drafted_model > 0, "draft model never drafted"
+        assert stats.spec_drafted == (
+            stats.spec_drafted_ngram + stats.spec_drafted_model)
+        if news is max_news:  # the short sampled replay may accept none
+            assert stats.spec_accepted > 0
+        assert engine.pool.used == 0
+        assert engine.draft_pool.used == 0, "draft blocks leaked"
 
 
 @pytest.mark.parametrize("spec_k,chunk", [(4, 4)])

@@ -120,6 +120,35 @@ def test_tp_speculative_serving_token_identical(model, single_gen, tp_gen):
     assert stats.spec_drafted > 0 and stats.spec_accepted > 0
 
 
+def test_tp_draft_model_engine_token_identical(model, single_gen, devices):
+    """Acceptance: the draft-model engine under tp=2 — target AND draft
+    pools sharded on the same mesh — reproduces the sequential greedy
+    streams token-for-token (the single-device draft engine is pinned to
+    the same reference in tests/test_serving.py, so the two engines are
+    transitively identical)."""
+    cfg, params = model
+    dcfg = tiny_config(name="test-tiny-draft", n_layer=1,
+                       block_size=cfg.block_size)
+    dparams = init_params(dcfg, jax.random.PRNGKey(1))
+    cyc = [np.random.default_rng(s).integers(1, cfg.vocab_size, 5).tolist()
+           for s in (5, 7, 0)]
+    max_news = [20, 16, 12]
+    want = _sequential_greedy(single_gen, cyc, max_news)
+    mesh = make_mesh({"tp": 2}, devices[:2])
+    gen = Generator(cfg, params, cache_dtype=jnp.float32, mesh=mesh)
+    dgen = Generator(dcfg, dparams, cache_dtype=jnp.float32, mesh=mesh)
+    engine = gen.serve(block_size=4, max_batch=3, decode_chunk=4, spec_k=4,
+                       draft_model="test-tiny-draft", draft_gen=dgen)
+    for i, (p, m) in enumerate(zip(cyc, max_news)):
+        engine.add_request(f"r{i}", p, m)
+    results, stats = engine.run()
+    for i in range(len(cyc)):
+        assert results[f"r{i}"] == want[i], f"r{i} diverged under tp=2"
+    assert stats.spec_drafted_model > 0
+    assert engine.draft_pool.used == 0
+    assert "tp" in str(engine._draft_kv["k"].sharding.spec)
+
+
 def test_tp_preemption_resume_parity(model, single_gen, tp_gen):
     """A pool sized to force recompute preemption: victims resume and
     re-feed through the sharded mixed step, outputs exact, blocks drained."""
